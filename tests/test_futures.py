@@ -1,0 +1,77 @@
+"""Timeout engine + work handle tests (reference: ``torchft/futures_test.py``)."""
+
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from torchft_tpu.futures import context_timeout, future_timeout, future_wait, schedule_timeout
+from torchft_tpu.work import DummyWork, Event, Work, failed_work
+
+
+def test_schedule_and_cancel() -> None:
+    fired = []
+    handle = schedule_timeout(0.1, lambda: fired.append(1))
+    time.sleep(0.3)
+    assert fired == [1]
+    assert handle.fired
+
+    handle2 = schedule_timeout(0.2, lambda: fired.append(2))
+    handle2.cancel()
+    time.sleep(0.4)
+    assert fired == [1]
+
+
+def test_future_timeout_fires() -> None:
+    fut: Future = Future()
+    out = future_timeout(fut, 0.1)
+    with pytest.raises(TimeoutError):
+        out.result(timeout=5.0)
+
+
+def test_future_timeout_passthrough() -> None:
+    fut: Future = Future()
+    out = future_timeout(fut, 5.0)
+    fut.set_result(42)
+    assert out.result(timeout=1.0) == 42
+
+
+def test_future_wait() -> None:
+    fut: Future = Future()
+    fut.set_result("v")
+    assert future_wait(fut, 1.0) == "v"
+
+
+def test_context_timeout() -> None:
+    fired = []
+    with context_timeout(lambda: fired.append(1), 5.0):
+        pass
+    time.sleep(0.1)
+    assert fired == []
+
+    with context_timeout(lambda: fired.append(2), 0.05):
+        time.sleep(0.3)
+    assert fired == [2]
+
+
+def test_work_then_chain() -> None:
+    fut: Future = Future()
+    work = Work(fut).then(lambda v: v + 1).then(lambda v: v * 2)
+    fut.set_result(10)
+    assert work.wait(timeout=1.0) == 22
+
+
+def test_work_then_error_propagates() -> None:
+    work = failed_work(RuntimeError("boom")).then(lambda v: v)
+    assert isinstance(work.exception(timeout=1.0), RuntimeError)
+
+
+def test_dummy_work() -> None:
+    assert DummyWork("x").wait() == "x"
+
+
+def test_event() -> None:
+    e = Event()
+    assert not e.synchronize(timeout=0.01)
+    e.record()
+    assert e.synchronize(timeout=0.01)

@@ -1,0 +1,153 @@
+"""Store + wire protocol tests (reference analog: TCPStore usage contracts in
+``torchft/process_group.py:109-128`` and ``torchft/manager.py:333-334``)."""
+
+import threading
+import time
+
+import pytest
+
+from torchft_tpu.store import PrefixStore, StoreClient, StoreServer, create_store_client
+from torchft_tpu.wire import (
+    ManagerQuorumResult,
+    Quorum,
+    QuorumMember,
+    Reader,
+    Writer,
+)
+
+
+@pytest.fixture()
+def store():
+    server = StoreServer("127.0.0.1:0")
+    client = StoreClient(f"127.0.0.1:{server.port}", timeout=5.0)
+    yield server, client
+    client.close()
+    server.shutdown()
+
+
+def test_set_get(store) -> None:
+    _, client = store
+    client.set("alpha", b"1")
+    assert client.get("alpha") == b"1"
+    client.set("alpha", b"2")
+    assert client.get("alpha") == b"2"
+
+
+def test_get_waits_for_key(store) -> None:
+    server, client = store
+    other = StoreClient(f"127.0.0.1:{server.port}", timeout=5.0)
+
+    def _late_set() -> None:
+        time.sleep(0.2)
+        other.set("late", b"v")
+
+    t = threading.Thread(target=_late_set)
+    t.start()
+    start = time.monotonic()
+    assert client.get("late", timeout=5.0) == b"v"
+    assert time.monotonic() - start >= 0.15
+    t.join()
+    other.close()
+
+
+def test_get_timeout(store) -> None:
+    _, client = store
+    start = time.monotonic()
+    with pytest.raises(TimeoutError):
+        client.get("never", timeout=0.3)
+    assert time.monotonic() - start < 2.0
+
+
+def test_add_and_exists(store) -> None:
+    _, client = store
+    assert not client.exists("ctr")
+    assert client.add("ctr", 2) == 2
+    assert client.add("ctr", 3) == 5
+    assert client.exists("ctr")
+
+
+def test_delete_prefix(store) -> None:
+    _, client = store
+    client.set("q/1/a", b"x")
+    client.set("q/1/b", b"x")
+    client.set("q/2/a", b"x")
+    assert client.delete_prefix("q/1") == 2
+    assert client.exists("q/2/a")
+
+
+def test_prefix_store(store) -> None:
+    server, client = store
+    ns = PrefixStore(client, "torchft/7/0")
+    ns.set("rank0", b"addr")
+    raw = StoreClient(f"127.0.0.1:{server.port}", timeout=5.0)
+    assert raw.get("torchft/7/0/rank0") == b"addr"
+    nested = PrefixStore(ns, "inner")
+    nested.set("k", b"v")
+    assert raw.get("torchft/7/0/inner/k") == b"v"
+    raw.close()
+
+
+def test_create_store_client(store) -> None:
+    server, _ = store
+    ns = create_store_client(f"127.0.0.1:{server.port}/torchft/3/1", timeout=5.0)
+    ns.set("x", b"y")
+    raw = StoreClient(f"127.0.0.1:{server.port}", timeout=5.0)
+    assert raw.get("torchft/3/1/x") == b"y"
+    raw.close()
+
+
+def test_concurrent_adds(store) -> None:
+    server, _ = store
+    clients = [StoreClient(f"127.0.0.1:{server.port}", timeout=5.0) for _ in range(8)]
+
+    def _bump(c: StoreClient) -> None:
+        for _ in range(50):
+            c.add("n", 1)
+
+    threads = [threading.Thread(target=_bump, args=(c,)) for c in clients]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert clients[0].add("n", 0) == 400
+    for c in clients:
+        c.close()
+
+
+def test_wire_roundtrip_quorum() -> None:
+    member = QuorumMember(
+        replica_id="train_ft_7:uuid",
+        address="http://host:1234",
+        store_address="host:2345",
+        step=17,
+        world_size=4,
+        shrink_only=True,
+        commit_failures=2,
+        data='{"k": 1}',
+    )
+    quorum = Quorum(quorum_id=9, participants=[member], created=123.5)
+    w = Writer()
+    quorum.encode(w)
+    decoded = Quorum.decode(Reader(w.payload()))
+    assert decoded == quorum
+
+
+def test_wire_roundtrip_manager_result() -> None:
+    res = ManagerQuorumResult(
+        quorum_id=3,
+        replica_rank=1,
+        replica_world_size=3,
+        recover_src_manager_address="http://a:1",
+        recover_src_replica_rank=None,
+        recover_dst_replica_ranks=[0, 2],
+        store_address="b:2",
+        max_step=10,
+        max_replica_rank=1,
+        max_world_size=2,
+        heal=False,
+        commit_failures=1,
+        replica_ids=["a", "b", "c"],
+    )
+    w = Writer()
+    res.encode(w)
+    assert ManagerQuorumResult.decode(Reader(w.payload())) == res

@@ -1,0 +1,51 @@
+"""torchft_tpu: a TPU-native per-step fault-tolerance framework.
+
+This package provides the capabilities of the reference system
+(meta-pytorch/torchft, see /root/reference) re-designed TPU-first on top of
+JAX/XLA:
+
+- A **coordination plane**: a Lighthouse quorum/heartbeat service and a
+  per-replica-group Manager server (reference: ``src/lighthouse.rs``,
+  ``src/manager.rs``) speaking a compact framed wire protocol, with both a
+  pure-Python implementation and a C++ implementation (``native/``).
+- A **data plane**: reconfigurable ``Communicator`` objects for the replica
+  (outer data-parallel) dimension that run host-side over DCN/TCP and can be
+  torn down and re-formed on a live TPU job without restarting XLA
+  (reference: ``torchft/process_group.py``).  Inside a replica group,
+  parallelism is expressed with ``jax.sharding`` over an ICI mesh and stays
+  inside compiled XLA programs.
+- A **Manager** state machine driving per-step quorum, gradient averaging,
+  commit voting, and live peer-to-peer healing (reference:
+  ``torchft/manager.py``).
+- **Training-loop wrappers**: an optax ``OptimizerWrapper``, fault-tolerant
+  gradient averaging, ``LocalSGD`` and (Streaming) ``DiLoCo``
+  (reference: ``torchft/optim.py``, ``torchft/ddp.py``,
+  ``torchft/local_sgd.py``).
+- **Checkpoint transports** that stream live weights between peers for
+  heal-in (reference: ``torchft/checkpointing/``).
+
+The key TPU-first design decision (SURVEY.md §7): the replica dimension is
+*outside* the XLA program.  Compiled train steps never bake in the replica
+count — the gradient divisor is a runtime scalar — so membership changes only
+swap the host-side communicator and never trigger recompilation.
+"""
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "Manager": ("torchft_tpu.manager", "Manager"),
+    "WorldSizeMode": ("torchft_tpu.manager", "WorldSizeMode"),
+    "OptimizerWrapper": ("torchft_tpu.optim", "OptimizerWrapper"),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name: str):  # lazy so partial builds / light deps stay importable
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)

@@ -1,0 +1,394 @@
+"""Framed binary wire protocol for the torchft_tpu control plane.
+
+The reference implements its control plane as gRPC/protobuf services
+(``proto/torchft.proto:37-130``, tonic servers in ``src/lighthouse.rs`` /
+``src/manager.rs``).  We use a purpose-built framed binary protocol instead:
+it needs no code generation, is trivially implementable from both Python and
+C++ (``native/``), and the control plane traffic is tiny (a few KB per step).
+
+Framing
+-------
+Every message is one frame::
+
+    u32  payload_len          (little endian, excludes these 4 bytes)
+    u8   msg_type             (MsgType)
+    ...  body                 (fields in fixed order per message type)
+
+Primitive encodings (all little endian):
+
+- ``u8`` / ``u32`` / ``u64`` / ``i64``: fixed width integers
+- ``f64``: IEEE double
+- ``str``: ``u32`` length + UTF-8 bytes
+- ``bytes``: ``u32`` length + raw bytes
+- ``bool``: ``u8`` 0/1
+- ``list<T>``: ``u32`` count + items
+- ``optional<T>``: ``u8`` present flag + value when present
+
+Request deadlines ride in the request body as ``timeout_ms`` (u64) — the
+server honors the client's deadline on blocking RPCs the same way the
+reference parses the ``grpc-timeout`` header server-side
+(``src/timeout.rs:26-69``).
+
+Errors are returned as an ``ERROR`` frame carrying an error code and a
+message; clients raise ``TimeoutError`` for deadline errors, mirroring the
+pyo3 timeout mapping in ``src/lib.rs:673-685``.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import List, Optional
+
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+
+class MsgType(IntEnum):
+    # Store ops (store.py)
+    STORE_SET = 0x01
+    STORE_GET = 0x02
+    STORE_ADD = 0x03
+    STORE_EXISTS = 0x04
+    STORE_DELETE = 0x05
+    STORE_OK = 0x0E
+    # Lighthouse service (reference proto/torchft.proto:69-73)
+    LH_QUORUM_REQ = 0x10
+    LH_QUORUM_RESP = 0x11
+    LH_HEARTBEAT_REQ = 0x12
+    LH_HEARTBEAT_RESP = 0x13
+    LH_STATUS_REQ = 0x14
+    LH_STATUS_RESP = 0x15
+    # Manager service (reference proto/torchft.proto:124-130)
+    MGR_QUORUM_REQ = 0x20
+    MGR_QUORUM_RESP = 0x21
+    MGR_CKPT_META_REQ = 0x22
+    MGR_CKPT_META_RESP = 0x23
+    MGR_SHOULD_COMMIT_REQ = 0x24
+    MGR_SHOULD_COMMIT_RESP = 0x25
+    MGR_KILL_REQ = 0x26
+    MGR_KILL_RESP = 0x27
+    # Communicator data plane (communicator.py)
+    COMM_HELLO = 0x30
+    COMM_DATA = 0x31
+    # Error frame (any service)
+    ERROR = 0x7F
+
+
+class ErrCode(IntEnum):
+    UNKNOWN = 0
+    TIMEOUT = 1
+    NOT_FOUND = 2
+    INVALID = 3
+    SHUTDOWN = 4
+
+
+class WireError(RuntimeError):
+    def __init__(self, code: ErrCode, msg: str) -> None:
+        super().__init__(msg)
+        self.code = code
+
+
+class Writer:
+    """Append-only little-endian message builder."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def u8(self, v: int) -> "Writer":
+        self._buf += struct.pack("<B", v)
+        return self
+
+    def u32(self, v: int) -> "Writer":
+        self._buf += struct.pack("<I", v)
+        return self
+
+    def u64(self, v: int) -> "Writer":
+        self._buf += struct.pack("<Q", v)
+        return self
+
+    def i64(self, v: int) -> "Writer":
+        self._buf += struct.pack("<q", v)
+        return self
+
+    def f64(self, v: float) -> "Writer":
+        self._buf += struct.pack("<d", v)
+        return self
+
+    def boolean(self, v: bool) -> "Writer":
+        return self.u8(1 if v else 0)
+
+    def string(self, v: str) -> "Writer":
+        raw = v.encode("utf-8")
+        self.u32(len(raw))
+        self._buf += raw
+        return self
+
+    def blob(self, v: bytes) -> "Writer":
+        self.u32(len(v))
+        self._buf += v
+        return self
+
+    def opt_i64(self, v: Optional[int]) -> "Writer":
+        if v is None:
+            return self.u8(0)
+        return self.u8(1).i64(v)
+
+    def payload(self) -> bytes:
+        return bytes(self._buf)
+
+
+class Reader:
+    """Sequential little-endian message parser."""
+
+    __slots__ = ("_view", "_off")
+
+    def __init__(self, data: bytes) -> None:
+        self._view = memoryview(data)
+        self._off = 0
+
+    def _take(self, n: int) -> memoryview:
+        if self._off + n > len(self._view):
+            raise WireError(ErrCode.INVALID, "truncated frame")
+        out = self._view[self._off : self._off + n]
+        self._off += n
+        return out
+
+    def u8(self) -> int:
+        return struct.unpack("<B", self._take(1))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self._take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self._take(8))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self._take(8))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self._take(8))[0]
+
+    def boolean(self) -> bool:
+        return self.u8() != 0
+
+    def string(self) -> str:
+        n = self.u32()
+        return bytes(self._take(n)).decode("utf-8")
+
+    def blob(self) -> bytes:
+        n = self.u32()
+        return bytes(self._take(n))
+
+    def opt_i64(self) -> Optional[int]:
+        if self.u8() == 0:
+            return None
+        return self.i64()
+
+    def done(self) -> bool:
+        return self._off == len(self._view)
+
+
+# ---------------------------------------------------------------------------
+# Shared control-plane dataclasses
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class QuorumMember:
+    """One replica group in a quorum.
+
+    Mirrors ``QuorumMember`` in the reference wire protocol
+    (``proto/torchft.proto:37-47``): identity, RPC address, store address for
+    communicator rendezvous, current step, group world size, and the
+    shrink_only / commit_failures / opaque-data knobs.
+    """
+
+    replica_id: str
+    address: str = ""
+    store_address: str = ""
+    step: int = 0
+    world_size: int = 1
+    shrink_only: bool = False
+    commit_failures: int = 0
+    data: str = ""
+
+    def encode(self, w: Writer) -> None:
+        (
+            w.string(self.replica_id)
+            .string(self.address)
+            .string(self.store_address)
+            .i64(self.step)
+            .u64(self.world_size)
+            .boolean(self.shrink_only)
+            .i64(self.commit_failures)
+            .string(self.data)
+        )
+
+    @staticmethod
+    def decode(r: Reader) -> "QuorumMember":
+        return QuorumMember(
+            replica_id=r.string(),
+            address=r.string(),
+            store_address=r.string(),
+            step=r.i64(),
+            world_size=r.u64(),
+            shrink_only=r.boolean(),
+            commit_failures=r.i64(),
+            data=r.string(),
+        )
+
+
+@dataclass
+class Quorum:
+    """A computed quorum (``proto/torchft.proto`` ``Quorum`` message)."""
+
+    quorum_id: int
+    participants: List[QuorumMember] = field(default_factory=list)
+    created: float = 0.0  # unix seconds
+
+    def encode(self, w: Writer) -> None:
+        w.i64(self.quorum_id).f64(self.created).u32(len(self.participants))
+        for p in self.participants:
+            p.encode(w)
+
+    @staticmethod
+    def decode(r: Reader) -> "Quorum":
+        quorum_id = r.i64()
+        created = r.f64()
+        n = r.u32()
+        return Quorum(
+            quorum_id=quorum_id,
+            created=created,
+            participants=[QuorumMember.decode(r) for _ in range(n)],
+        )
+
+
+@dataclass
+class ManagerQuorumResult:
+    """Per-rank quorum view computed by the manager server.
+
+    Mirrors ``ManagerQuorumResponse`` (``proto/torchft.proto:84-100``) and the
+    pyo3 ``QuorumResult`` (``src/lib.rs:284-319``): the deterministic
+    replica_rank, recovery source/destinations, the primary store address for
+    communicator rendezvous, and max-step participation facts.
+    """
+
+    quorum_id: int = 0
+    replica_rank: int = 0
+    replica_world_size: int = 1
+    recover_src_manager_address: str = ""
+    recover_src_replica_rank: Optional[int] = None
+    recover_dst_replica_ranks: List[int] = field(default_factory=list)
+    store_address: str = ""
+    max_step: int = 0
+    max_replica_rank: Optional[int] = None
+    max_world_size: int = 1
+    heal: bool = False
+    commit_failures: int = 0
+    replica_ids: List[str] = field(default_factory=list)
+
+    def encode(self, w: Writer) -> None:
+        w.i64(self.quorum_id)
+        w.i64(self.replica_rank)
+        w.i64(self.replica_world_size)
+        w.string(self.recover_src_manager_address)
+        w.opt_i64(self.recover_src_replica_rank)
+        w.u32(len(self.recover_dst_replica_ranks))
+        for rank in self.recover_dst_replica_ranks:
+            w.i64(rank)
+        w.string(self.store_address)
+        w.i64(self.max_step)
+        w.opt_i64(self.max_replica_rank)
+        w.i64(self.max_world_size)
+        w.boolean(self.heal)
+        w.i64(self.commit_failures)
+        w.u32(len(self.replica_ids))
+        for rid in self.replica_ids:
+            w.string(rid)
+
+    @staticmethod
+    def decode(r: Reader) -> "ManagerQuorumResult":
+        out = ManagerQuorumResult()
+        out.quorum_id = r.i64()
+        out.replica_rank = r.i64()
+        out.replica_world_size = r.i64()
+        out.recover_src_manager_address = r.string()
+        out.recover_src_replica_rank = r.opt_i64()
+        out.recover_dst_replica_ranks = [r.i64() for _ in range(r.u32())]
+        out.store_address = r.string()
+        out.max_step = r.i64()
+        out.max_replica_rank = r.opt_i64()
+        out.max_world_size = r.i64()
+        out.heal = r.boolean()
+        out.commit_failures = r.i64()
+        out.replica_ids = [r.string() for _ in range(r.u32())]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Socket framing helpers
+# ---------------------------------------------------------------------------
+
+
+def send_frame(sock: socket.socket, msg_type: int, payload: bytes = b"") -> None:
+    header = struct.pack("<IB", len(payload) + 1, msg_type)
+    sock.sendall(header + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise ConnectionError("connection closed mid-frame")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> tuple[int, Reader]:
+    """Receive one frame, returning (msg_type, body reader).
+
+    Raises ``ConnectionError`` on EOF and ``socket.timeout`` on socket
+    timeouts (callers translate to ``TimeoutError``).
+    """
+    (length,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if length < 1 or length > MAX_FRAME_BYTES:
+        raise WireError(ErrCode.INVALID, f"bad frame length {length}")
+    body = _recv_exact(sock, length)
+    return body[0], Reader(body[1:])
+
+
+def send_error(sock: socket.socket, code: ErrCode, msg: str) -> None:
+    send_frame(sock, MsgType.ERROR, Writer().u8(int(code)).string(msg).payload())
+
+
+def raise_if_error(msg_type: int, r: Reader) -> None:
+    """Translate an ERROR frame into the appropriate Python exception."""
+    if msg_type != MsgType.ERROR:
+        return
+    code = ErrCode(r.u8())
+    msg = r.string()
+    if code == ErrCode.TIMEOUT:
+        raise TimeoutError(msg)
+    raise WireError(code, msg)
+
+
+def connect(addr: str, timeout: float) -> socket.socket:
+    """Dial ``host:port`` with a connect deadline.
+
+    The reference's channel helper retries with exponential backoff and HTTP2
+    keepalives (``src/net.rs:16-42``); TCP keepalive serves the same
+    dead-server-detection role here.
+    """
+    host, port_str = addr.rsplit(":", 1)
+    host = host.strip("[]")
+    sock = socket.create_connection((host, int(port_str)), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    return sock
