@@ -1,0 +1,334 @@
+"""Lighthouse quorum algorithm + server tests.
+
+Mirrors the reference's Rust test matrix (``src/lighthouse.rs:612-1296``):
+join timeout, heartbeat expiry, fast quorum, shrink_only, split brain,
+commit-failure quorum bump, join-during-shrink e2e — plus the Python-side
+timing test (``torchft/lighthouse_test.py:17-66``).
+"""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from torchft_tpu.lighthouse import (
+    LighthouseClient,
+    LighthouseConfig,
+    LighthouseServer,
+    _MemberDetails,
+    _State,
+    quorum_compute,
+)
+from torchft_tpu.wire import Quorum, QuorumMember
+
+
+def _member(replica_id: str, step: int = 1, shrink_only: bool = False, commit_failures: int = 0) -> QuorumMember:
+    return QuorumMember(
+        replica_id=replica_id,
+        address=f"addr_{replica_id}",
+        store_address=f"store_{replica_id}",
+        step=step,
+        world_size=1,
+        shrink_only=shrink_only,
+        commit_failures=commit_failures,
+    )
+
+
+def _join(state: _State, now: float, member: QuorumMember) -> None:
+    state.participants[member.replica_id] = _MemberDetails(joined=now, member=member)
+    state.heartbeats[member.replica_id] = now
+
+
+HOUR_MS = 60 * 60 * 1000
+
+
+class TestQuorumCompute:
+    def test_join_timeout(self) -> None:
+        cfg = LighthouseConfig(min_replicas=1, join_timeout_ms=HOUR_MS)
+        state = _State()
+        now = 1000.0
+
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is None
+        assert (
+            "New quorum not ready, only have 0 participants, need min_replicas 1 "
+            "[0/0 participants healthy]" in reason
+        )
+
+        _join(state, now, _member("a"))
+        _join(state, now, _member("b"))
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is not None, reason
+
+        # healthy worker not participating → wait for join timeout
+        state.heartbeats["c"] = now
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is None
+        assert "join timeout" in reason
+
+        # pass the join timeout window
+        state.participants["a"].joined = now - 10 * 3600
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is not None, reason
+
+    def test_heartbeats(self) -> None:
+        cfg = LighthouseConfig(min_replicas=1, join_timeout_ms=0)
+        state = _State()
+        now = 1000.0
+
+        _join(state, now, _member("a"))
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is not None
+        assert "[1/1 participants healthy][1 heartbeating]" in reason
+
+        # expired heartbeat
+        state.heartbeats["a"] = now - 10
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is None
+        assert "[0/1 participants healthy][0 heartbeating]" in reason
+
+        # 1 healthy, 1 expired
+        _join(state, now, _member("b"))
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is not None
+        assert len(met) == 1 and met[0].replica_id == "b"
+
+    def test_fast_prev_quorum(self) -> None:
+        cfg = LighthouseConfig(min_replicas=1, join_timeout_ms=HOUR_MS)
+        state = _State()
+        now = 1000.0
+
+        assert quorum_compute(now, state, cfg)[0] is None
+
+        _join(state, now, _member("a"))
+        # one worker alive (heartbeating) but not participating → split brain rule
+        state.heartbeats["b"] = now
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is None
+        assert "need at least half" in reason
+
+        # with a prev quorum covering all healthy participants → fast path
+        state.prev_quorum = Quorum(quorum_id=1, participants=[_member("a")])
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is not None, reason
+        assert "Fast quorum" in reason
+
+        # fast quorum can also expand
+        _join(state, now, _member("b"))
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is not None
+        assert len(met) == 2
+
+    def test_shrink_only(self) -> None:
+        cfg = LighthouseConfig(min_replicas=1, join_timeout_ms=HOUR_MS)
+        state = _State()
+        now = 1000.0
+
+        state.prev_quorum = Quorum(
+            quorum_id=1, participants=[_member("a"), _member("b")]
+        )
+        _join(state, now, _member("a", shrink_only=True))
+        # participant not in prev quorum must be excluded by shrink_only
+        _join(state, now, _member("c", shrink_only=True))
+
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is not None, reason
+        assert "[shrink_only=True]" in reason
+        assert len(met) == 1
+        assert met[0].replica_id == "a"
+
+    def test_split_brain(self) -> None:
+        cfg = LighthouseConfig(min_replicas=1, join_timeout_ms=HOUR_MS)
+        state = _State()
+        now = 1000.0
+
+        assert quorum_compute(now, state, cfg)[0] is None
+        _join(state, now, _member("a"))
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is not None, reason
+
+        state.heartbeats["b"] = now
+        met, reason = quorum_compute(now, state, cfg)
+        assert met is None
+        assert (
+            "New quorum not ready, only have 1 participants, need at least half "
+            "of 2 healthy workers [1/1 participants healthy][2 heartbeating]"
+            in reason
+        )
+
+    def test_sorted_output(self) -> None:
+        cfg = LighthouseConfig(min_replicas=1, join_timeout_ms=0)
+        state = _State()
+        now = 1000.0
+        for rid in ["zeta", "alpha", "mike"]:
+            _join(state, now, _member(rid))
+        met, _ = quorum_compute(now, state, cfg)
+        assert [m.replica_id for m in met] == ["alpha", "mike", "zeta"]
+
+
+def _quorum_in_thread(client_addr: str, member_kwargs: dict, out: list) -> threading.Thread:
+    def _run() -> None:
+        client = LighthouseClient(client_addr, connect_timeout=5.0)
+        try:
+            out.append(client.quorum(timeout=10.0, **member_kwargs))
+        finally:
+            client.close()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    return t
+
+
+class TestLighthouseServer:
+    def test_e2e_single_replica(self) -> None:
+        server = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1, quorum_tick_ms=10
+        )
+        try:
+            client = LighthouseClient(server.local_address(), connect_timeout=5.0)
+            client.heartbeat("foo")
+            quorum = client.quorum(replica_id="foo", timeout=5.0, step=10)
+            assert len(quorum.participants) == 1
+            assert quorum.participants[0].step == 10
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_quorum_timing_fast(self) -> None:
+        """Quorum forms well under 0.4s with join_timeout_ms=100
+        (reference Python assertion ``torchft/lighthouse_test.py:50-53``)."""
+        server = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100, quorum_tick_ms=10
+        )
+        try:
+            client = LighthouseClient(server.local_address(), connect_timeout=5.0)
+            start = time.monotonic()
+            client.quorum(replica_id="solo", timeout=5.0)
+            assert time.monotonic() - start < 0.4
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_quorum_rpc_timeout(self) -> None:
+        server = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=HOUR_MS, quorum_tick_ms=10
+        )
+        try:
+            client = LighthouseClient(server.local_address(), connect_timeout=5.0)
+            start = time.monotonic()
+            with pytest.raises(TimeoutError):
+                client.quorum(replica_id="lonely", timeout=0.2)
+            assert time.monotonic() - start < 1.0
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_join_during_shrink(self) -> None:
+        """Port of ``test_lighthouse_join_during_shrink``
+        (``src/lighthouse.rs:1114-1224``)."""
+        server = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=1000, quorum_tick_ms=10
+        )
+        addr = server.local_address()
+        try:
+            # 1. first quorum
+            out0: list = []
+            t0 = _quorum_in_thread(addr, dict(replica_id="replica0", step=1), out0)
+            c1 = LighthouseClient(addr, connect_timeout=5.0)
+            q1 = c1.quorum(replica_id="replica1", timeout=10.0, step=1)
+            t0.join(timeout=10.0)
+            assert [p.replica_id for p in q1.participants] == ["replica0", "replica1"]
+            assert q1.participants[1].step == 1
+
+            # 2. joiner parks while the existing members shrink
+            join_out: list = []
+            joiner_t = _quorum_in_thread(addr, dict(replica_id="joiner", step=1), join_out)
+            time.sleep(0.05)
+
+            out0 = []
+            t0 = _quorum_in_thread(
+                addr, dict(replica_id="replica0", step=2, shrink_only=True), out0
+            )
+            q2 = c1.quorum(replica_id="replica1", timeout=10.0, step=2)
+            t0.join(timeout=10.0)
+            assert all(p.replica_id != "joiner" for p in q2.participants)
+            assert [p.replica_id for p in q2.participants] == ["replica0", "replica1"]
+            assert q2.participants[1].step == 2
+
+            # 3. next non-shrink quorum includes the joiner
+            out0 = []
+            t0 = _quorum_in_thread(addr, dict(replica_id="replica0", step=3), out0)
+            q3 = c1.quorum(replica_id="replica1", timeout=10.0, step=3)
+            t0.join(timeout=10.0)
+            joiner_t.join(timeout=10.0)
+            assert any(p.replica_id == "joiner" for p in q3.participants)
+            assert len(q3.participants) == 3
+            assert join_out and any(
+                p.replica_id == "joiner" for p in join_out[0].participants
+            )
+            c1.close()
+        finally:
+            server.shutdown()
+
+    def test_commit_failures_bump_quorum_id(self) -> None:
+        """Port of ``test_lighthouse_commit_failures``
+        (``src/lighthouse.rs:1227-1296``)."""
+        server = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=1000, quorum_tick_ms=10
+        )
+        addr = server.local_address()
+        try:
+            client = LighthouseClient(addr, connect_timeout=5.0)
+            for _ in range(2):
+                out: list = []
+                t = _quorum_in_thread(
+                    addr, dict(replica_id="replica0", step=10), out
+                )
+                q = client.quorum(replica_id="replica1", timeout=10.0, step=10)
+                t.join(timeout=10.0)
+                assert q.quorum_id == 1
+                assert [p.commit_failures for p in q.participants] == [0, 0]
+
+            out = []
+            t = _quorum_in_thread(addr, dict(replica_id="replica0", step=10), out)
+            q = client.quorum(
+                replica_id="replica1", timeout=10.0, step=10, commit_failures=2
+            )
+            t.join(timeout=10.0)
+            assert q.quorum_id == 2
+            assert [p.commit_failures for p in q.participants] == [0, 2]
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_http_status_dashboard(self) -> None:
+        server = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1, quorum_tick_ms=10
+        )
+        try:
+            client = LighthouseClient(server.local_address(), connect_timeout=5.0)
+            client.quorum(replica_id="dash", timeout=5.0, step=3)
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/status.json", timeout=5.0
+            ) as resp:
+                import json
+
+                status = json.loads(resp.read())
+            assert status["quorum_id"] == 1
+            assert status["max_step"] == 3
+            assert status["participants"][0]["replica_id"] == "dash"
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/status", timeout=5.0
+            ) as resp:
+                page = resp.read().decode()
+            assert "dash" in page and "lighthouse" in page
+
+            # wire status rpc
+            st = client.status()
+            assert st["quorum_id"] == 1
+            client.close()
+        finally:
+            server.shutdown()

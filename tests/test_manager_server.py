@@ -1,0 +1,430 @@
+"""Manager server / recovery-assignment tests.
+
+Ports the reference's Rust test matrix (``src/manager.rs:627-1218``):
+compute_quorum_results for first step / recovery / skip-init-sync / commit
+failures, the should_commit AND-barrier, checkpoint metadata, end-to-end
+quorum through a real lighthouse, and lighthouse-down retry behavior.
+"""
+
+import threading
+import time
+from typing import List, Optional
+
+import pytest
+
+from torchft_tpu.lighthouse import LighthouseServer
+from torchft_tpu.manager_server import (
+    ManagerClient,
+    ManagerServer,
+    compute_quorum_results,
+)
+from torchft_tpu.wire import (
+    ErrCode,
+    MsgType,
+    Quorum,
+    QuorumMember,
+    Reader,
+    WireError,
+    Writer,
+    recv_frame,
+    send_error,
+    send_frame,
+)
+
+
+def _member(i: int, step: int = 0, commit_failures: int = 0) -> QuorumMember:
+    return QuorumMember(
+        replica_id=f"replica_{i}",
+        address=f"addr_{i}",
+        store_address=f"store_addr_{i}",
+        step=step,
+        world_size=1,
+        commit_failures=commit_failures,
+    )
+
+
+class TestComputeQuorumResults:
+    def test_first_step(self) -> None:
+        quorum = Quorum(quorum_id=1, participants=[_member(0), _member(1)])
+
+        results = compute_quorum_results("replica_0", 0, quorum, True)
+        assert not results.heal
+        assert results.replica_rank == 0
+        assert results.recover_src_replica_rank is None
+        assert results.recover_dst_replica_ranks == [1]
+
+        results = compute_quorum_results("replica_1", 0, quorum, True)
+        assert results.heal
+        assert results.replica_rank == 1
+        assert results.recover_src_replica_rank == 0
+        assert results.recover_dst_replica_ranks == []
+
+        # group_rank 1: assignments offset from rank 0, different primary
+        results = compute_quorum_results("replica_1", 1, quorum, True)
+        assert not results.heal
+        assert results.replica_rank == 1
+        assert results.recover_src_replica_rank is None
+        assert results.recover_dst_replica_ranks == [0]
+
+    def test_recovery(self) -> None:
+        quorum = Quorum(
+            quorum_id=1,
+            participants=[
+                _member(0, step=0),
+                _member(1, step=1),
+                _member(2, step=0),
+                _member(3, step=1),
+                _member(4, step=0),
+            ],
+        )
+
+        results = compute_quorum_results("replica_0", 0, quorum, True)
+        assert results.heal
+        assert results.recover_src_manager_address == "addr_1"
+        assert results.replica_rank == 0
+        assert results.recover_src_replica_rank == 1
+        assert results.recover_dst_replica_ranks == []
+
+        results = compute_quorum_results("replica_1", 0, quorum, True)
+        assert not results.heal
+        assert results.recover_src_manager_address == ""
+        assert results.replica_rank == 1
+        assert results.recover_src_replica_rank is None
+        assert results.recover_dst_replica_ranks == [0, 4]
+
+        results = compute_quorum_results("replica_3", 0, quorum, True)
+        assert not results.heal
+        assert results.replica_rank == 3
+        assert results.recover_src_replica_rank is None
+        assert results.recover_dst_replica_ranks == [2]
+
+        # group_rank 1: offset assignment
+        results = compute_quorum_results("replica_1", 1, quorum, True)
+        assert not results.heal
+        assert results.replica_rank == 1
+        assert results.recover_src_replica_rank is None
+        assert results.recover_dst_replica_ranks == [2]
+
+    def test_skip_init_sync(self) -> None:
+        quorum = Quorum(quorum_id=1, participants=[_member(0), _member(1)])
+
+        assert not compute_quorum_results("replica_0", 0, quorum, True).heal
+        assert compute_quorum_results("replica_1", 0, quorum, True).heal
+        # init_sync=False skips the forced step-0 sync
+        assert not compute_quorum_results("replica_1", 0, quorum, False).heal
+        # but actual step skew still heals
+        quorum.participants[0].step = 1
+        assert compute_quorum_results("replica_1", 0, quorum, False).heal
+
+    def test_commit_failures(self) -> None:
+        quorum = Quorum(
+            quorum_id=1,
+            participants=[_member(0), _member(1, commit_failures=2)],
+        )
+        assert compute_quorum_results("replica_0", 0, quorum, True).commit_failures == 2
+
+    def test_not_in_quorum_raises(self) -> None:
+        quorum = Quorum(quorum_id=1, participants=[_member(0)])
+        with pytest.raises(WireError):
+            compute_quorum_results("replica_9", 0, quorum, True)
+
+    def test_max_step_facts(self) -> None:
+        quorum = Quorum(
+            quorum_id=5,
+            participants=[_member(0, step=3), _member(1, step=5), _member(2, step=5)],
+        )
+        results = compute_quorum_results("replica_1", 0, quorum, True)
+        assert results.max_step == 5
+        assert results.max_world_size == 2
+        assert results.max_replica_rank == 0
+        assert results.replica_world_size == 3
+        assert results.store_address == "store_addr_1"
+        assert results.replica_ids == ["replica_0", "replica_1", "replica_2"]
+
+
+@pytest.fixture()
+def lighthouse():
+    server = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100, quorum_tick_ms=10
+    )
+    yield server
+    server.shutdown()
+
+
+def _manager(lighthouse: LighthouseServer, replica_id: str, world_size: int = 1, **kw) -> ManagerServer:
+    return ManagerServer(
+        replica_id=replica_id,
+        lighthouse_addr=lighthouse.local_address(),
+        hostname="127.0.0.1",
+        bind="127.0.0.1:0",
+        store_addr=f"store_{replica_id}",
+        world_size=world_size,
+        **kw,
+    )
+
+
+class TestManagerServer:
+    def test_get_quorum(self, lighthouse) -> None:
+        mgr = _manager(lighthouse, "rep_0")
+        try:
+            client = ManagerClient(f"127.0.0.1:{mgr.port}")
+            resp = client._quorum(
+                group_rank=0,
+                step=123,
+                checkpoint_metadata="addr",
+                shrink_only=False,
+                timeout=10.0,
+            )
+            assert resp.quorum_id == 1
+            assert resp.replica_rank == 0
+            assert resp.replica_world_size == 1
+            assert not resp.heal
+            assert resp.max_step == 123
+            assert resp.replica_ids == ["rep_0"]
+            client.close()
+        finally:
+            mgr.shutdown()
+
+    def test_get_quorum_heal_first_step(self, lighthouse) -> None:
+        """Two fresh replicas at step 0 with init_sync → exactly one heals
+        (``src/manager.rs:761-832``)."""
+        mgr0 = _manager(lighthouse, "rep_0")
+        mgr1 = _manager(lighthouse, "rep_1")
+        try:
+            results: List[Optional[object]] = [None, None]
+
+            def _ask(i: int, mgr: ManagerServer) -> None:
+                client = ManagerClient(f"127.0.0.1:{mgr.port}")
+                results[i] = client._quorum(
+                    group_rank=0,
+                    step=0,
+                    checkpoint_metadata=f"meta_{i}",
+                    shrink_only=False,
+                    timeout=10.0,
+                )
+                client.close()
+
+            threads = [
+                threading.Thread(target=_ask, args=(i, m))
+                for i, m in enumerate([mgr0, mgr1])
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10.0)
+
+            assert results[0] is not None and results[1] is not None
+            heals = [r.heal for r in results]
+            assert sum(heals) == 1
+            healer = results[heals.index(True)]
+            sender = results[heals.index(False)]
+            assert healer.recover_src_replica_rank == sender.replica_rank
+            assert sender.recover_dst_replica_ranks == [healer.replica_rank]
+        finally:
+            mgr0.shutdown()
+            mgr1.shutdown()
+
+    def test_should_commit(self, lighthouse) -> None:
+        """AND of votes across the group (``src/manager.rs:657-703``)."""
+        mgr = _manager(lighthouse, "rep_0", world_size=2)
+        try:
+            c0 = ManagerClient(f"127.0.0.1:{mgr.port}")
+            c1 = ManagerClient(f"127.0.0.1:{mgr.port}")
+
+            out: List[Optional[bool]] = [None]
+
+            def _vote0(value: bool) -> None:
+                out[0] = c0.should_commit(0, 0, value, timeout=10.0)
+
+            t = threading.Thread(target=_vote0, args=(True,))
+            t.start()
+            assert c1.should_commit(1, 0, False, timeout=10.0) is False
+            t.join(timeout=10.0)
+            assert out[0] is False
+
+            # next round: all true → True (state must have reset)
+            t = threading.Thread(target=_vote0, args=(True,))
+            t.start()
+            assert c1.should_commit(1, 0, True, timeout=10.0) is True
+            t.join(timeout=10.0)
+            assert out[0] is True
+            c0.close()
+            c1.close()
+        finally:
+            mgr.shutdown()
+
+    def test_checkpoint_metadata(self, lighthouse) -> None:
+        mgr = _manager(lighthouse, "rep_0")
+        try:
+            client = ManagerClient(f"127.0.0.1:{mgr.port}")
+            with pytest.raises(WireError, match="rank not found"):
+                client._checkpoint_metadata(0, timeout=5.0)
+
+            client._quorum(
+                group_rank=0,
+                step=0,
+                checkpoint_metadata="addr",
+                shrink_only=False,
+                timeout=10.0,
+            )
+            assert client._checkpoint_metadata(0, timeout=5.0) == "addr"
+            client.close()
+        finally:
+            mgr.shutdown()
+
+    def test_quorum_barrier_blocks_until_all_ranks(self, lighthouse) -> None:
+        mgr = _manager(lighthouse, "rep_0", world_size=2)
+        try:
+            c0 = ManagerClient(f"127.0.0.1:{mgr.port}")
+            c1 = ManagerClient(f"127.0.0.1:{mgr.port}")
+            t0 = time.monotonic()
+            res: List[Optional[object]] = [None]
+
+            def _rank0() -> None:
+                res[0] = c0._quorum(
+                    group_rank=0,
+                    step=7,
+                    checkpoint_metadata="m0",
+                    shrink_only=False,
+                    timeout=10.0,
+                )
+
+            t = threading.Thread(target=_rank0)
+            t.start()
+            time.sleep(0.3)  # rank 0 must still be parked
+            assert res[0] is None
+            r1 = c1._quorum(
+                group_rank=1,
+                step=7,
+                checkpoint_metadata="m1",
+                shrink_only=False,
+                timeout=10.0,
+            )
+            t.join(timeout=10.0)
+            assert res[0] is not None
+            assert res[0].quorum_id == r1.quorum_id
+            assert time.monotonic() - t0 < 10.0
+            c0.close()
+            c1.close()
+        finally:
+            mgr.shutdown()
+
+    def test_should_commit_rpc_timeout(self, lighthouse) -> None:
+        """A lone vote in a 2-rank group times out promptly
+        (reference Python assertion ``torchft/manager_integ_test.py:555-567``)."""
+        mgr = _manager(lighthouse, "rep_0", world_size=2)
+        try:
+            client = ManagerClient(f"127.0.0.1:{mgr.port}")
+            start = time.monotonic()
+            with pytest.raises(TimeoutError):
+                client.should_commit(0, 0, True, timeout=0.01)
+            assert time.monotonic() - start < 1.0
+            client.close()
+        finally:
+            mgr.shutdown()
+
+
+class _MockLighthouse:
+    """Fails the first ``fail_count`` quorum RPCs (``src/manager.rs:1110-1180``)."""
+
+    def __init__(self, fail_count: int) -> None:
+        import socket as socket_mod
+
+        self._fail_count = fail_count
+        self._count = 0
+        self._sock = socket_mod.socket()
+        self._sock.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn) -> None:
+        try:
+            while True:
+                msg_type, r = recv_frame(conn)
+                if msg_type == MsgType.LH_HEARTBEAT_REQ:
+                    r.string()
+                    send_frame(conn, MsgType.LH_HEARTBEAT_RESP)
+                elif msg_type == MsgType.LH_QUORUM_REQ:
+                    requester = QuorumMember.decode(r)
+                    self._count += 1
+                    if self._count <= self._fail_count:
+                        send_error(conn, ErrCode.UNKNOWN, "simulated failure")
+                        continue
+                    quorum = Quorum(quorum_id=1, participants=[requester])
+                    w = Writer()
+                    quorum.encode(w)
+                    send_frame(conn, MsgType.LH_QUORUM_RESP, w.payload())
+        except (ConnectionError, OSError, WireError):
+            pass
+
+    def shutdown(self) -> None:
+        self._sock.close()
+
+
+def test_get_quorum_when_lighthouse_flaky() -> None:
+    """quorum_retries=1 survives one lighthouse failure
+    (``src/manager.rs:1182-1218``)."""
+    mock = _MockLighthouse(fail_count=1)
+    mgr = ManagerServer(
+        replica_id="rep_id",
+        lighthouse_addr=f"127.0.0.1:{mock.port}",
+        hostname="127.0.0.1",
+        bind="127.0.0.1:0",
+        store_addr="store_addr",
+        world_size=1,
+        quorum_retries=1,
+    )
+    try:
+        client = ManagerClient(f"127.0.0.1:{mgr.port}")
+        resp = client._quorum(
+            group_rank=0,
+            step=123,
+            checkpoint_metadata="addr",
+            shrink_only=False,
+            timeout=3.0,
+            commit_failures=3,
+        )
+        assert resp.quorum_id == 1
+        client.close()
+    finally:
+        mgr.shutdown()
+        mock.shutdown()
+
+
+def test_get_quorum_lighthouse_down_fails_fast() -> None:
+    """With zero retries and a dead lighthouse, parked ranks get an error
+    (improvement over the reference's hang-to-deadline TODO,
+    ``src/manager.rs:238``)."""
+    mgr = ManagerServer(
+        replica_id="rep_id",
+        lighthouse_addr="127.0.0.1:1",  # nothing listens here
+        hostname="127.0.0.1",
+        bind="127.0.0.1:0",
+        store_addr="store_addr",
+        world_size=1,
+        quorum_retries=0,
+        connect_timeout=0.2,
+    )
+    try:
+        client = ManagerClient(f"127.0.0.1:{mgr.port}")
+        with pytest.raises((WireError, TimeoutError)):
+            client._quorum(
+                group_rank=0,
+                step=0,
+                checkpoint_metadata="",
+                shrink_only=False,
+                timeout=3.0,
+            )
+        client.close()
+    finally:
+        mgr.shutdown()

@@ -42,6 +42,9 @@ class RWLock:
                     self._writer = True
                 finally:
                     self._writers_waiting -= 1
+                    if not self._writer:
+                        # timed out: wake readers parked on writers_waiting>0
+                        self._cond.notify_all()
             else:
                 while self._writer or self._writers_waiting > 0:
                     remaining = deadline - time.monotonic()

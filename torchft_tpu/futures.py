@@ -139,7 +139,12 @@ def future_timeout(fut: "Future[Any]", timeout_s: float) -> "Future[Any]":
     out: Future[Any] = Future()
 
     def _on_timeout() -> None:
-        out.set_exception(TimeoutError(f"future timed out after {timeout_s}s"))
+        if out.done():
+            return  # lost the race against fut completing; benign
+        try:
+            out.set_exception(TimeoutError(f"future timed out after {timeout_s}s"))
+        except Exception:  # noqa: BLE001 - resolved between check and set
+            pass
 
     handle = schedule_timeout(timeout_s, _on_timeout)
 

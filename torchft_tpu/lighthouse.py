@@ -1,0 +1,602 @@
+"""Lighthouse: global membership / quorum service.
+
+One lighthouse runs per job.  Replica groups register participation via the
+blocking ``quorum`` RPC and send periodic heartbeats; the lighthouse computes
+a quorum each tick and broadcasts it to every parked requester.  This is the
+behavioral twin of the reference's Rust lighthouse (``src/lighthouse.rs``):
+
+- ``quorum_compute`` (``src/lighthouse.rs:141-269``): filter participants by
+  heartbeat freshness; take the *fast quorum* when every previous-quorum
+  member is back; otherwise require ``min_replicas``, a majority of all
+  heartbeating replicas (anti split-brain), and wait ``join_timeout_ms`` for
+  healthy stragglers before issuing a smaller quorum. ``shrink_only``
+  restricts candidates to previous members.
+- Tick loop every ``quorum_tick_ms`` (``src/lighthouse.rs:345-352``);
+  ``quorum_id`` bumps on membership change or on any member reporting commit
+  failures (``src/lighthouse.rs:307-325``); participants are cleared after a
+  quorum is issued so each round re-registers.
+- The ``quorum`` RPC registers the requester (implicit heartbeat), runs a
+  proactive tick, then parks until a quorum *containing the requester*
+  arrives, re-registering if a quorum excludes it
+  (``src/lighthouse.rs:484-551``); the server honors the client's deadline
+  like the reference honors ``grpc-timeout`` (``src/timeout.rs``).
+- The same listener also answers plain HTTP: ``/`` and ``/status`` render a
+  dashboard and ``/replica/{id}/kill`` forwards a Kill RPC to that replica's
+  manager (``src/lighthouse.rs:370-388,454-479``).  We sniff the first bytes
+  of each connection to route HTTP vs framed RPC on one port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import logging
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from torchft_tpu.wire import (
+    ErrCode,
+    MsgType,
+    Quorum,
+    QuorumMember,
+    Reader,
+    WireError,
+    Writer,
+    connect,
+    raise_if_error,
+    recv_frame,
+    send_error,
+    send_frame,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class LighthouseConfig:
+    """CLI-visible knobs (``src/lighthouse.rs:94-131``)."""
+
+    min_replicas: int
+    bind: str = "0.0.0.0:0"
+    join_timeout_ms: int = 60_000
+    quorum_tick_ms: int = 100
+    heartbeat_timeout_ms: int = 5_000
+
+
+@dataclass
+class _MemberDetails:
+    joined: float
+    member: QuorumMember
+
+
+@dataclass
+class _State:
+    participants: Dict[str, _MemberDetails] = field(default_factory=dict)
+    heartbeats: Dict[str, float] = field(default_factory=dict)
+    prev_quorum: Optional[Quorum] = None
+    quorum_id: int = 0
+
+
+def quorum_compute(
+    now: float, state: _State, cfg: LighthouseConfig
+) -> Tuple[Optional[List[QuorumMember]], str]:
+    """Decide whether a quorum can be issued right now.
+
+    Pure function mirroring ``quorum_compute`` (``src/lighthouse.rs:141-269``)
+    so the full Rust unit-test matrix applies directly.
+    """
+    hb_timeout_s = cfg.heartbeat_timeout_ms / 1000.0
+    healthy_replicas = {
+        rid for rid, ts in state.heartbeats.items() if now - ts < hb_timeout_s
+    }
+    healthy_participants = {
+        rid: d for rid, d in state.participants.items() if rid in healthy_replicas
+    }
+
+    candidates = sorted(
+        (d.member for d in healthy_participants.values()), key=lambda m: m.replica_id
+    )
+    shrink_only = any(d.member.shrink_only for d in healthy_participants.values())
+
+    metadata = (
+        f"[{len(healthy_participants)}/{len(state.participants)} participants healthy]"
+        f"[{len(healthy_replicas)} heartbeating][shrink_only={shrink_only}]"
+    )
+
+    if state.prev_quorum is not None:
+        prev_ids = {m.replica_id for m in state.prev_quorum.participants}
+        if shrink_only:
+            candidates = [m for m in candidates if m.replica_id in prev_ids]
+        # Fast quorum: every member of the previous quorum is healthy and has
+        # re-registered — no need to wait for stragglers.
+        if all(rid in healthy_participants for rid in prev_ids):
+            return candidates, f"Fast quorum found! {metadata}"
+
+    if len(healthy_participants) < cfg.min_replicas:
+        return (
+            None,
+            f"New quorum not ready, only have {len(healthy_participants)} "
+            f"participants, need min_replicas {cfg.min_replicas} {metadata}",
+        )
+
+    # Anti split-brain: a quorum must represent a strict majority of every
+    # replica the lighthouse believes is alive.
+    if len(healthy_participants) <= len(healthy_replicas) // 2:
+        return (
+            None,
+            f"New quorum not ready, only have {len(healthy_participants)} "
+            f"participants, need at least half of {len(healthy_replicas)} "
+            f"healthy workers {metadata}",
+        )
+
+    all_healthy_joined = len(healthy_participants) == len(healthy_replicas)
+    first_joined = min(
+        (d.joined for d in healthy_participants.values()), default=now
+    )
+    if (
+        not all_healthy_joined
+        and now - first_joined < cfg.join_timeout_ms / 1000.0
+    ):
+        return (
+            None,
+            f"Valid quorum with {len(healthy_participants)} participants, "
+            f"waiting for {len(healthy_replicas) - len(healthy_participants)} "
+            f"healthy but not participating stragglers due to join timeout "
+            f"{metadata}",
+        )
+
+    return candidates, f"Valid quorum found {metadata}"
+
+
+def _quorum_changed(a: List[QuorumMember], b: List[QuorumMember]) -> bool:
+    return [m.replica_id for m in a] != [m.replica_id for m in b]
+
+
+class LighthouseServer:
+    """Threaded lighthouse server.
+
+    The reference runs this as a tokio service inside either the standalone
+    ``torchft_lighthouse`` binary or the training process via pyo3
+    (``src/lib.rs:609-671``); here it is a daemon-threaded object you
+    construct and ``shutdown()``.
+    """
+
+    def __init__(
+        self,
+        bind: str = "0.0.0.0:0",
+        min_replicas: int = 1,
+        join_timeout_ms: int = 100,
+        quorum_tick_ms: int = 100,
+        heartbeat_timeout_ms: int = 5_000,
+    ) -> None:
+        # NB: the pyo3 binding defaults join_timeout_ms to 100 for tests
+        # (src/lib.rs:609-671); the CLI default is 60s.
+        self._cfg = LighthouseConfig(
+            min_replicas=min_replicas,
+            bind=bind,
+            join_timeout_ms=join_timeout_ms,
+            quorum_tick_ms=quorum_tick_ms,
+            heartbeat_timeout_ms=heartbeat_timeout_ms,
+        )
+        self._state = _State()
+        self._lock = threading.Condition()
+        self._generation = 0  # bumped on every broadcast quorum
+        self._change_reason: Optional[str] = None
+        self._shutdown = False
+
+        host, port = bind.rsplit(":", 1)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(512)
+        self._port: int = self._sock.getsockname()[1]
+
+        self._accept_thread = threading.Thread(
+            target=self._serve, name="tpuft_lighthouse_accept", daemon=True
+        )
+        self._accept_thread.start()
+        self._tick_thread = threading.Thread(
+            target=self._run_ticks, name="tpuft_lighthouse_tick", daemon=True
+        )
+        self._tick_thread.start()
+        logger.info("Lighthouse listening on %s", self.address())
+
+    # -- public surface ----------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def address(self) -> str:
+        return f"{socket.gethostname()}:{self._port}"
+
+    def local_address(self) -> str:
+        return f"127.0.0.1:{self._port}"
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            self._lock.notify_all()
+
+    # -- tick loop ---------------------------------------------------------
+
+    def _run_ticks(self) -> None:
+        while not self._shutdown:
+            time.sleep(self._cfg.quorum_tick_ms / 1000.0)
+            with self._lock:
+                self._tick_locked()
+
+    def _log_if_changed(self, reason: str) -> None:
+        if reason != self._change_reason:
+            logger.info("Quorum status: %s", reason)
+            self._change_reason = reason
+
+    def _tick_locked(self) -> None:
+        """One quorum decision round (``src/lighthouse.rs:292-343``)."""
+        participants, reason = quorum_compute(time.monotonic(), self._state, self._cfg)
+        self._log_if_changed(reason)
+        if participants is None:
+            return
+
+        commit_failure_ids = [
+            m.replica_id for m in participants if m.commit_failures > 0
+        ]
+        state = self._state
+        if state.prev_quorum is None or _quorum_changed(
+            participants, state.prev_quorum.participants
+        ):
+            state.quorum_id += 1
+            logger.info("Detected quorum change, bumping quorum_id to %d", state.quorum_id)
+        elif commit_failure_ids:
+            state.quorum_id += 1
+            logger.info(
+                "Detected commit failures in [%s], bumping quorum_id to %d",
+                ", ".join(commit_failure_ids),
+                state.quorum_id,
+            )
+
+        quorum = Quorum(
+            quorum_id=state.quorum_id,
+            participants=list(participants),
+            created=time.time(),
+        )
+        state.prev_quorum = quorum
+        state.participants.clear()
+        self._generation += 1
+        self._lock.notify_all()
+
+    # -- connection handling ----------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._shutdown:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._handle_conn,
+                args=(conn,),
+                name="tpuft_lighthouse_conn",
+                daemon=True,
+            ).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            # Peek enough bytes to distinguish HTTP from framed RPC; a slow
+            # sender may deliver the first bytes across several segments.
+            conn.settimeout(10.0)
+            head = b""
+            sniff_deadline = time.monotonic() + 10.0
+            while len(head) < 4:
+                head = conn.recv(4, socket.MSG_PEEK)
+                if not head or time.monotonic() > sniff_deadline:
+                    if len(head) < 4:
+                        return
+                if len(head) < 4:
+                    time.sleep(0.01)
+            conn.settimeout(None)
+            if head[:3] in (b"GET", b"POS", b"HEA"):
+                self._handle_http(conn)
+                return
+            while True:
+                msg_type, r = recv_frame(conn)
+                if msg_type == MsgType.LH_QUORUM_REQ:
+                    self._handle_quorum(conn, r)
+                elif msg_type == MsgType.LH_HEARTBEAT_REQ:
+                    replica_id = r.string()
+                    with self._lock:
+                        self._state.heartbeats[replica_id] = time.monotonic()
+                    send_frame(conn, MsgType.LH_HEARTBEAT_RESP)
+                elif msg_type == MsgType.LH_STATUS_REQ:
+                    send_frame(
+                        conn,
+                        MsgType.LH_STATUS_RESP,
+                        Writer().string(json.dumps(self._status())).payload(),
+                    )
+                else:
+                    send_error(conn, ErrCode.INVALID, f"bad lighthouse op {msg_type}")
+        except (ConnectionError, OSError, WireError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _register(self, requester: QuorumMember) -> None:
+        now = time.monotonic()
+        self._state.heartbeats[requester.replica_id] = now  # implicit heartbeat
+        self._state.participants[requester.replica_id] = _MemberDetails(
+            joined=now, member=requester
+        )
+
+    def _handle_quorum(self, conn: socket.socket, r: Reader) -> None:
+        requester = QuorumMember.decode(r)
+        timeout_ms = r.u64()
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        logger.info("Received quorum request for replica %s", requester.replica_id)
+
+        with self._lock:
+            self._register(requester)
+            gen = self._generation
+            self._tick_locked()  # proactive tick
+            while True:
+                if self._generation > gen:
+                    gen = self._generation
+                    quorum = self._state.prev_quorum
+                    assert quorum is not None
+                    if any(
+                        p.replica_id == requester.replica_id
+                        for p in quorum.participants
+                    ):
+                        break
+                    # Quorum formed without us (e.g. we registered right
+                    # after a round closed): re-register and keep waiting.
+                    logger.info(
+                        "Replica %s not in quorum, retrying", requester.replica_id
+                    )
+                    self._register(requester)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._shutdown:
+                    send_error(
+                        conn,
+                        ErrCode.SHUTDOWN if self._shutdown else ErrCode.TIMEOUT,
+                        f"quorum request for {requester.replica_id!r} "
+                        f"{'aborted by shutdown' if self._shutdown else 'timed out'}",
+                    )
+                    return
+                self._lock.wait(min(remaining, 0.1))
+
+        w = Writer()
+        quorum.encode(w)
+        send_frame(conn, MsgType.LH_QUORUM_RESP, w.payload())
+
+    # -- status / dashboard -------------------------------------------------
+
+    def _status(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            _, reason = quorum_compute(now, self._state, self._cfg)
+            prev = self._state.prev_quorum
+            return {
+                "quorum_id": self._state.quorum_id,
+                "quorum_status": reason,
+                "max_step": max((p.step for p in prev.participants), default=-1)
+                if prev
+                else -1,
+                "num_participants": len(prev.participants) if prev else -1,
+                "participants": [
+                    {
+                        "replica_id": p.replica_id,
+                        "address": p.address,
+                        "store_address": p.store_address,
+                        "step": p.step,
+                        "world_size": p.world_size,
+                    }
+                    for p in (prev.participants if prev else [])
+                ],
+                "heartbeats": {
+                    rid: now - ts for rid, ts in self._state.heartbeats.items()
+                },
+            }
+
+    def _handle_http(self, conn: socket.socket) -> None:
+        """Minimal dashboard (``templates/status.html`` analog)."""
+        conn.settimeout(5.0)
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = conn.recv(4096)
+            if not chunk:
+                return
+            data += chunk
+        request_line = data.split(b"\r\n", 1)[0].decode("latin-1")
+        parts = request_line.split()
+        path = parts[1] if len(parts) >= 2 else "/"
+
+        if path.startswith("/replica/") and path.endswith("/kill"):
+            replica_id = path[len("/replica/") : -len("/kill")]
+            ok, msg = self._kill_replica(replica_id)
+            body = json.dumps({"ok": ok, "msg": msg}).encode()
+            status = "200 OK" if ok else "404 Not Found"
+            ctype = "application/json"
+        elif path == "/status.json":
+            body = json.dumps(self._status(), indent=2).encode()
+            status, ctype = "200 OK", "application/json"
+        else:
+            body = self._render_status_html().encode()
+            status, ctype = "200 OK", "text/html; charset=utf-8"
+        resp = (
+            f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+        ).encode() + body
+        try:
+            conn.sendall(resp)
+        except OSError:
+            pass
+
+    def _kill_replica(self, replica_id: str) -> Tuple[bool, str]:
+        """Dashboard kill button → Kill RPC at the replica's manager
+        (``src/lighthouse.rs:454-479``)."""
+        with self._lock:
+            prev = self._state.prev_quorum
+            addr = next(
+                (
+                    m.address
+                    for m in (prev.participants if prev else [])
+                    if m.replica_id == replica_id
+                ),
+                None,
+            )
+        if addr is None:
+            return False, "failed to find replica"
+        try:
+            sock = connect(addr, timeout=10.0)
+            send_frame(sock, MsgType.MGR_KILL_REQ, Writer().string("killed from dashboard").payload())
+            sock.close()
+            return True, f"kill sent to {replica_id}"
+        except OSError as e:
+            return False, f"kill failed: {e}"
+
+    def _render_status_html(self) -> str:
+        s = self._status()
+        cards = "".join(
+            f"<div class='card'><b>{html.escape(p['replica_id'])}</b>"
+            f"<br>step {p['step']} · ws {p['world_size']}"
+            f"<br><code>{html.escape(p['address'])}</code>"
+            f"<br><a href='/replica/{html.escape(p['replica_id'])}/kill'>kill</a></div>"
+            for p in s["participants"]
+        )
+        beats = "".join(
+            f"<li><code>{html.escape(rid)}</code>: {age:.1f}s ago</li>"
+            for rid, age in sorted(s["heartbeats"].items())
+        )
+        return (
+            "<html><head><title>torchft_tpu lighthouse</title><style>"
+            "body{font-family:monospace;margin:2em}.card{border:1px solid #999;"
+            "display:inline-block;padding:1em;margin:.5em}</style></head><body>"
+            f"<h1>torchft_tpu lighthouse</h1>"
+            f"<p>quorum_id={s['quorum_id']} · status: {html.escape(s['quorum_status'])}</p>"
+            f"<p>max_step={s['max_step']} · participants={s['num_participants']}</p>"
+            f"{cards}<h2>heartbeats</h2><ul>{beats}</ul></body></html>"
+        )
+
+
+class LighthouseClient:
+    """Client for :class:`LighthouseServer` (pyo3 analog ``src/lib.rs:486-594``)."""
+
+    def __init__(self, addr: str, connect_timeout: float = 60.0) -> None:
+        self._addr = addr
+        self._connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = connect(addr, connect_timeout)
+
+    def _drop_socket(self) -> None:
+        # A late response after a client-side timeout would mispair with the
+        # next rpc; drop and re-dial instead.
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _call(self, msg_type: MsgType, payload: bytes, timeout: float) -> Tuple[int, Reader]:
+        with self._lock:
+            if self._sock is None:
+                self._sock = connect(self._addr, self._connect_timeout)
+            self._sock.settimeout(timeout)
+            try:
+                send_frame(self._sock, msg_type, payload)
+                return recv_frame(self._sock)
+            except socket.timeout as e:
+                self._drop_socket()
+                raise TimeoutError(f"lighthouse rpc {msg_type.name} timed out") from e
+            except (ConnectionError, OSError):
+                self._drop_socket()
+                raise
+
+    def quorum(
+        self,
+        replica_id: str,
+        timeout: float,
+        address: str = "",
+        store_address: str = "",
+        step: int = 0,
+        world_size: int = 1,
+        shrink_only: bool = False,
+        commit_failures: int = 0,
+        data: Optional[dict] = None,
+    ) -> Quorum:
+        """Block until a quorum containing this replica is issued.
+
+        ``data`` is an arbitrary JSON-serializable dict carried opaquely in
+        the member record (``src/lib.rs:430-451``).
+        """
+        member = QuorumMember(
+            replica_id=replica_id,
+            address=address,
+            store_address=store_address,
+            step=step,
+            world_size=world_size,
+            shrink_only=shrink_only,
+            commit_failures=commit_failures,
+            data=json.dumps(data) if data else "",
+        )
+        w = Writer()
+        member.encode(w)
+        w.u64(int(timeout * 1000))
+        msg_type, r = self._call(MsgType.LH_QUORUM_REQ, w.payload(), timeout + 5.0)
+        raise_if_error(msg_type, r)
+        return Quorum.decode(r)
+
+    def heartbeat(self, replica_id: str, timeout: float = 5.0) -> None:
+        msg_type, r = self._call(
+            MsgType.LH_HEARTBEAT_REQ, Writer().string(replica_id).payload(), timeout
+        )
+        raise_if_error(msg_type, r)
+
+    def status(self, timeout: float = 5.0) -> dict:
+        msg_type, r = self._call(MsgType.LH_STATUS_REQ, b"", timeout)
+        raise_if_error(msg_type, r)
+        return json.loads(r.string())
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop_socket()
+
+
+def lighthouse_main(argv: Optional[List[str]] = None) -> None:
+    """CLI entry point (``src/bin/lighthouse.rs``)."""
+    parser = argparse.ArgumentParser("torchft_tpu_lighthouse")
+    parser.add_argument("--bind", default="0.0.0.0:29510")
+    parser.add_argument("--min_replicas", type=int, required=True)
+    parser.add_argument("--join_timeout_ms", type=int, default=60_000)
+    parser.add_argument("--quorum_tick_ms", type=int, default=100)
+    parser.add_argument("--heartbeat_timeout_ms", type=int, default=5_000)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = LighthouseServer(
+        bind=args.bind,
+        min_replicas=args.min_replicas,
+        join_timeout_ms=args.join_timeout_ms,
+        quorum_tick_ms=args.quorum_tick_ms,
+        heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    lighthouse_main()

@@ -1,0 +1,567 @@
+"""Per-replica-group Manager sidecar: barrier, recovery math, commit voting.
+
+The reference runs a Rust ``ManagerServer`` inside the rank-0 Python process
+of every replica group (``src/manager.rs:80-328``); all local ranks connect
+to it with a ``ManagerClient``.  Its three jobs:
+
+1. **Intra-group quorum barrier** (``src/manager.rs:332-402``): collect one
+   ``quorum`` RPC from each of the group's ``world_size`` ranks; when the
+   last arrives, forward a single request to the lighthouse (with retries and
+   client re-creation, ``src/manager.rs:250-306``) and broadcast the resulting
+   quorum to every parked rank.
+2. **Recovery assignment** (``compute_quorum_results``,
+   ``src/manager.rs:489-625``): sort participants by replica_id for a
+   deterministic replica_rank; find the max-step set; pick the primary store
+   owner ``group_rank % len(max_participants)``; round-robin assign each
+   stale replica a healthy recovery source, offset by group_rank so different
+   group ranks spread load across sources.
+3. **should_commit AND-barrier** (``src/manager.rs:423-479``): collect votes
+   from all local ranks; the decision is the AND of all votes; broadcast and
+   reset.
+
+It also stores per-rank checkpoint metadata for healing peers
+(``src/manager.rs:404-421``), heartbeats the lighthouse every
+``heartbeat_interval`` (``src/manager.rs:194-216``), and answers ``Kill``
+by exiting the process (``src/manager.rs:481-487``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from torchft_tpu.lighthouse import LighthouseClient
+from torchft_tpu.wire import (
+    ErrCode,
+    ManagerQuorumResult,
+    MsgType,
+    Quorum,
+    QuorumMember,
+    Reader,
+    WireError,
+    Writer,
+    connect,
+    raise_if_error,
+    recv_frame,
+    send_error,
+    send_frame,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def compute_quorum_results(
+    replica_id: str,
+    group_rank: int,
+    quorum: Quorum,
+    init_sync: bool,
+) -> ManagerQuorumResult:
+    """Derive this rank's view of a quorum (``src/manager.rs:489-625``)."""
+    participants = sorted(quorum.participants, key=lambda p: p.replica_id)
+
+    replica_rank = next(
+        (i for i, p in enumerate(participants) if p.replica_id == replica_id), None
+    )
+    if replica_rank is None:
+        raise WireError(
+            ErrCode.NOT_FOUND,
+            f"replica {replica_id} not participating in returned quorum",
+        )
+
+    max_step = max(p.step for p in participants)
+    max_participants = [p for p in participants if p.step == max_step]
+    max_replica_rank = next(
+        (
+            i
+            for i, p in enumerate(max_participants)
+            if p.replica_id == replica_id
+        ),
+        None,
+    )
+
+    # The primary store for communicator rendezvous this round; spreading by
+    # group_rank balances rendezvous load across up-to-date replicas.
+    primary = max_participants[group_rank % len(max_participants)]
+
+    # Replicas recover if behind max_step, or on a fresh job (max_step == 0
+    # with init_sync) where everyone but the primary pulls the primary's init.
+    force_recover = init_sync and max_step == 0
+    recover_dst = [
+        i
+        for i, p in enumerate(participants)
+        if p.step != max_step
+        or (force_recover and primary.replica_id != p.replica_id)
+    ]
+    recover_dst_set = set(recover_dst)
+    up_to_date = [i for i in range(len(participants)) if i not in recover_dst_set]
+
+    assignments: Dict[int, List[int]] = {}
+    recover_src: Optional[int] = None
+    for i, recovering in enumerate(recover_dst):
+        src = up_to_date[(i + group_rank) % len(up_to_date)]
+        assignments.setdefault(src, []).append(recovering)
+        if recovering == replica_rank:
+            recover_src = src
+
+    heal = recover_src is not None
+    if heal:
+        logger.info(
+            "[Replica %s] healing is required step=%d, max_step=%d, recover_src_replica_rank=%d",
+            replica_id,
+            participants[replica_rank].step,
+            max_step,
+            recover_src,
+        )
+
+    return ManagerQuorumResult(
+        quorum_id=quorum.quorum_id,
+        replica_rank=replica_rank,
+        replica_world_size=len(participants),
+        recover_src_manager_address=(
+            participants[recover_src].address if recover_src is not None else ""
+        ),
+        recover_src_replica_rank=recover_src,
+        recover_dst_replica_ranks=assignments.get(replica_rank, []),
+        store_address=primary.store_address,
+        max_step=max_step,
+        max_replica_rank=max_replica_rank,
+        max_world_size=len(max_participants),
+        heal=heal,
+        commit_failures=max(p.commit_failures for p in participants),
+        replica_ids=[p.replica_id for p in participants],
+    )
+
+
+class ManagerServer:
+    """Threaded manager sidecar for one replica group."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        hostname: str = "",
+        bind: str = "0.0.0.0:0",
+        store_addr: str = "",
+        world_size: int = 1,
+        heartbeat_interval: float = 0.1,
+        connect_timeout: float = 10.0,
+        quorum_retries: int = 0,
+        kill_fn: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self._replica_id = replica_id
+        self._lighthouse_addr = lighthouse_addr
+        self._hostname = hostname or socket.gethostname()
+        self._store_addr = store_addr
+        self._world_size = world_size
+        self._heartbeat_interval = heartbeat_interval
+        self._connect_timeout = connect_timeout
+        self._quorum_retries = quorum_retries
+        self._kill_fn = kill_fn or self._default_kill
+
+        self._lock = threading.Condition()
+        # quorum barrier state
+        self._participants: Dict[int, QuorumMember] = {}
+        self._checkpoint_metadata: Dict[int, str] = {}
+        self._quorum_gen = 0
+        self._latest: Optional[Quorum] = None
+        self._latest_err: Optional[str] = None
+        # should_commit barrier state
+        self._commit_votes: Set[int] = set()
+        self._commit_failures: Set[int] = set()
+        self._commit_gen = 0
+        self._commit_decision = False
+
+        self._shutdown = False
+
+        host, port = bind.rsplit(":", 1)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(64)
+        self._port: int = self._sock.getsockname()[1]
+
+        threading.Thread(
+            target=self._serve, name="tpuft_manager_accept", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._run_heartbeat, name="tpuft_manager_heartbeat", daemon=True
+        ).start()
+        logger.info(
+            "[Replica %s] Manager listening on %s", replica_id, self.address()
+        )
+
+    # -- public -------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def address(self) -> str:
+        return f"{self._hostname}:{self._port}"
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            self._lock.notify_all()
+
+    @staticmethod
+    def _default_kill(msg: str) -> None:
+        logger.warning("got kill request: %s", msg)
+        os._exit(1)
+
+    # -- background loops ---------------------------------------------------
+
+    def _run_heartbeat(self) -> None:
+        """Heartbeat the lighthouse until shutdown (``src/manager.rs:194-216``)."""
+        client: Optional[LighthouseClient] = None
+        while not self._shutdown:
+            try:
+                if client is None:
+                    client = LighthouseClient(
+                        self._lighthouse_addr, connect_timeout=self._connect_timeout
+                    )
+                client.heartbeat(self._replica_id)
+            except (OSError, TimeoutError, WireError) as e:
+                logger.info(
+                    "[Replica %s] failed to send heartbeat to lighthouse: %s",
+                    self._replica_id,
+                    e,
+                )
+                if client is not None:
+                    client.close()
+                client = None
+            time.sleep(self._heartbeat_interval)
+        if client is not None:
+            client.close()
+
+    # -- connection handling ------------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._shutdown:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._handle_conn,
+                args=(conn,),
+                name="tpuft_manager_conn",
+                daemon=True,
+            ).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg_type, r = recv_frame(conn)
+                if msg_type == MsgType.MGR_QUORUM_REQ:
+                    self._handle_quorum(conn, r)
+                elif msg_type == MsgType.MGR_CKPT_META_REQ:
+                    rank = r.i64()
+                    with self._lock:
+                        meta = self._checkpoint_metadata.get(rank)
+                    if meta is None:
+                        send_error(conn, ErrCode.INVALID, "rank not found")
+                    else:
+                        send_frame(
+                            conn,
+                            MsgType.MGR_CKPT_META_RESP,
+                            Writer().string(meta).payload(),
+                        )
+                elif msg_type == MsgType.MGR_SHOULD_COMMIT_REQ:
+                    self._handle_should_commit(conn, r)
+                elif msg_type == MsgType.MGR_KILL_REQ:
+                    msg = r.string()
+                    send_frame(conn, MsgType.MGR_KILL_RESP)
+                    self._kill_fn(msg)
+                else:
+                    send_error(conn, ErrCode.INVALID, f"bad manager op {msg_type}")
+        except (ConnectionError, OSError, WireError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- quorum barrier -----------------------------------------------------
+
+    def _handle_quorum(self, conn: socket.socket, r: Reader) -> None:
+        group_rank = r.i64()
+        step = r.i64()
+        checkpoint_metadata = r.string()
+        shrink_only = r.boolean()
+        init_sync = r.boolean()
+        commit_failures = r.i64()
+        timeout_ms = r.u64()
+        deadline = time.monotonic() + timeout_ms / 1000.0
+
+        logger.info(
+            "[Replica %s] Start quorum for group_rank %d", self._replica_id, group_rank
+        )
+
+        with self._lock:
+            self._checkpoint_metadata[group_rank] = checkpoint_metadata
+            member = QuorumMember(
+                replica_id=self._replica_id,
+                address=self.address(),
+                store_address=self._store_addr,
+                step=step,
+                world_size=self._world_size,
+                shrink_only=shrink_only,
+                commit_failures=commit_failures,
+            )
+            self._participants[group_rank] = member
+            gen = self._quorum_gen
+
+            if len(self._participants) == self._world_size:
+                self._participants.clear()
+                threading.Thread(
+                    target=self._run_quorum,
+                    args=(member, timeout_ms / 1000.0),
+                    name="tpuft_manager_quorum",
+                    daemon=True,
+                ).start()
+
+            while self._quorum_gen == gen:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._shutdown:
+                    send_error(
+                        conn,
+                        ErrCode.SHUTDOWN if self._shutdown else ErrCode.TIMEOUT,
+                        f"manager quorum for group_rank {group_rank} "
+                        f"{'aborted by shutdown' if self._shutdown else 'timed out'}",
+                    )
+                    return
+                self._lock.wait(min(remaining, 0.1))
+            quorum = self._latest
+            quorum_err = self._latest_err
+
+        if quorum is None:
+            send_error(conn, ErrCode.UNKNOWN, quorum_err or "quorum failed")
+            return
+
+        logger.info(
+            "[Replica %s] Finished quorum for group_rank %d",
+            self._replica_id,
+            group_rank,
+        )
+        try:
+            reply = compute_quorum_results(
+                self._replica_id, group_rank, quorum, init_sync
+            )
+        except WireError as e:
+            send_error(conn, e.code, str(e))
+            return
+        w = Writer()
+        reply.encode(w)
+        send_frame(conn, MsgType.MGR_QUORUM_RESP, w.payload())
+
+    def _run_quorum(self, requester: QuorumMember, timeout_s: float) -> None:
+        """Forward the group's request to the lighthouse with retries
+        (``src/manager.rs:218-306``) and broadcast the result.
+
+        Unlike the reference (which leaves waiters to hit their own deadlines
+        when every retry fails — a noted TODO at ``src/manager.rs:238``), we
+        broadcast the failure so parked ranks fail fast.
+        """
+        logger.info(
+            "[Replica %s] All workers joined - starting quorum", self._replica_id
+        )
+        quorum: Optional[Quorum] = None
+        last_err = "unknown"
+        for attempt in range(self._quorum_retries + 1):
+            client: Optional[LighthouseClient] = None
+            try:
+                client = LighthouseClient(
+                    self._lighthouse_addr, connect_timeout=self._connect_timeout
+                )
+                quorum = client.quorum(
+                    replica_id=requester.replica_id,
+                    timeout=timeout_s,
+                    address=requester.address,
+                    store_address=requester.store_address,
+                    step=requester.step,
+                    world_size=requester.world_size,
+                    shrink_only=requester.shrink_only,
+                    commit_failures=requester.commit_failures,
+                )
+                break
+            except (OSError, TimeoutError, WireError) as e:
+                last_err = str(e)
+                logger.info(
+                    "[Replica %s] lighthouse quorum failed (attempt %d): %s",
+                    self._replica_id,
+                    attempt,
+                    e,
+                )
+                if attempt < self._quorum_retries:
+                    # only back off when another attempt remains — otherwise
+                    # broadcast the failure to parked ranks immediately
+                    time.sleep(
+                        max(0.1, timeout_s / max(self._quorum_retries + 1, 1))
+                    )
+            finally:
+                if client is not None:
+                    client.close()
+
+        with self._lock:
+            self._latest = quorum
+            self._latest_err = (
+                None
+                if quorum is not None
+                else f"lighthouse quorum failed after {self._quorum_retries} retries: {last_err}"
+            )
+            self._quorum_gen += 1
+            self._lock.notify_all()
+
+    # -- should_commit barrier ----------------------------------------------
+
+    def _handle_should_commit(self, conn: socket.socket, r: Reader) -> None:
+        group_rank = r.i64()
+        _step = r.i64()
+        should_commit = r.boolean()
+        timeout_ms = r.u64()
+        deadline = time.monotonic() + timeout_ms / 1000.0
+
+        logger.info(
+            "[Replica %s] should_commit request from %d should_commit=%s",
+            self._replica_id,
+            group_rank,
+            should_commit,
+        )
+
+        with self._lock:
+            if not should_commit:
+                self._commit_failures.add(group_rank)
+            self._commit_votes.add(group_rank)
+            gen = self._commit_gen
+
+            if len(self._commit_votes) == self._world_size:
+                decision = len(self._commit_failures) == 0
+                logger.info(
+                    "[Replica %s] should_commit completed should_commit=%s",
+                    self._replica_id,
+                    decision,
+                )
+                self._commit_decision = decision
+                self._commit_votes.clear()
+                self._commit_failures.clear()
+                self._commit_gen += 1
+                self._lock.notify_all()
+
+            while self._commit_gen == gen:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._shutdown:
+                    send_error(
+                        conn,
+                        ErrCode.SHUTDOWN if self._shutdown else ErrCode.TIMEOUT,
+                        f"should_commit for group_rank {group_rank} "
+                        f"{'aborted by shutdown' if self._shutdown else 'timed out'}",
+                    )
+                    return
+                self._lock.wait(min(remaining, 0.1))
+            decision = self._commit_decision
+
+        send_frame(
+            conn,
+            MsgType.MGR_SHOULD_COMMIT_RESP,
+            Writer().boolean(decision).payload(),
+        )
+
+
+class ManagerClient:
+    """Client used by every local rank to reach its group's ManagerServer
+    (pyo3 analog ``src/lib.rs:153-282``)."""
+
+    def __init__(self, addr: str, connect_timeout: float = 10.0) -> None:
+        self._addr = addr
+        self._connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = connect(addr, connect_timeout)
+
+    def _drop_socket(self) -> None:
+        # A late response after a client-side timeout would mispair with the
+        # next rpc; drop and re-dial instead.
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _call(self, msg_type: MsgType, payload: bytes, timeout: float) -> Tuple[int, Reader]:
+        with self._lock:
+            if self._sock is None:
+                self._sock = connect(self._addr, self._connect_timeout)
+            self._sock.settimeout(timeout + 5.0)
+            try:
+                send_frame(self._sock, msg_type, payload)
+                return recv_frame(self._sock)
+            except socket.timeout as e:
+                self._drop_socket()
+                raise TimeoutError(f"manager rpc {msg_type.name} timed out") from e
+            except (ConnectionError, OSError):
+                self._drop_socket()
+                raise
+
+    def _quorum(
+        self,
+        group_rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool,
+        timeout: float,
+        init_sync: bool = True,
+        commit_failures: int = 0,
+    ) -> ManagerQuorumResult:
+        w = (
+            Writer()
+            .i64(group_rank)
+            .i64(step)
+            .string(checkpoint_metadata)
+            .boolean(shrink_only)
+            .boolean(init_sync)
+            .i64(commit_failures)
+            .u64(int(timeout * 1000))
+        )
+        msg_type, r = self._call(MsgType.MGR_QUORUM_REQ, w.payload(), timeout)
+        raise_if_error(msg_type, r)
+        return ManagerQuorumResult.decode(r)
+
+    def _checkpoint_metadata(self, rank: int, timeout: float) -> str:
+        msg_type, r = self._call(
+            MsgType.MGR_CKPT_META_REQ, Writer().i64(rank).payload(), timeout
+        )
+        raise_if_error(msg_type, r)
+        return r.string()
+
+    def should_commit(
+        self, group_rank: int, step: int, should_commit: bool, timeout: float
+    ) -> bool:
+        w = (
+            Writer()
+            .i64(group_rank)
+            .i64(step)
+            .boolean(should_commit)
+            .u64(int(timeout * 1000))
+        )
+        msg_type, r = self._call(MsgType.MGR_SHOULD_COMMIT_REQ, w.payload(), timeout)
+        raise_if_error(msg_type, r)
+        return r.boolean()
+
+    def kill(self, msg: str, timeout: float = 10.0) -> None:
+        msg_type, r = self._call(MsgType.MGR_KILL_REQ, Writer().string(msg).payload(), timeout)
+        raise_if_error(msg_type, r)
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop_socket()

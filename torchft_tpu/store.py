@@ -102,11 +102,20 @@ class StoreServer:
                 elif msg_type == MsgType.STORE_ADD:
                     key, delta = r.string(), r.i64()
                     with self._cond:
-                        cur = int(self._data.get(key, b"0"))
-                        cur += delta
-                        self._data[key] = str(cur).encode()
-                        self._cond.notify_all()
-                    send_frame(conn, MsgType.STORE_OK, Writer().i64(cur).payload())
+                        try:
+                            cur = int(self._data.get(key, b"0"))
+                        except ValueError:
+                            cur = None
+                        else:
+                            cur += delta
+                            self._data[key] = str(cur).encode()
+                            self._cond.notify_all()
+                    if cur is None:
+                        send_error(
+                            conn, ErrCode.INVALID, f"add on non-integer key {key!r}"
+                        )
+                    else:
+                        send_frame(conn, MsgType.STORE_OK, Writer().i64(cur).payload())
                 elif msg_type == MsgType.STORE_EXISTS:
                     key = r.string()
                     with self._cond:
@@ -163,17 +172,29 @@ class StoreClient:
         self._addr = addr
         self._timeout = timeout
         self._lock = threading.Lock()
-        self._sock = connect(addr, timeout)
+        self._sock: Optional[socket.socket] = connect(addr, timeout)
 
     @property
     def addr(self) -> str:
         return self._addr
+
+    def _drop_socket(self) -> None:
+        # After a client-side timeout the server's late response may still be
+        # in flight; reusing the socket would mispair it with the next rpc.
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def _call(
         self, msg_type: MsgType, payload: bytes, timeout: Optional[float] = None
     ) -> Reader:
         budget = self._timeout if timeout is None else timeout
         with self._lock:
+            if self._sock is None:
+                self._sock = connect(self._addr, self._timeout)
             # headroom over the server-side deadline so the server's timeout
             # error reaches us rather than a raw socket timeout
             self._sock.settimeout(budget + 5.0)
@@ -181,7 +202,11 @@ class StoreClient:
                 send_frame(self._sock, msg_type, payload)
                 resp_type, r = recv_frame(self._sock)
             except socket.timeout as e:
+                self._drop_socket()
                 raise TimeoutError(f"store rpc {msg_type.name} timed out") from e
+            except (ConnectionError, OSError):
+                self._drop_socket()
+                raise
         from torchft_tpu.wire import raise_if_error
 
         raise_if_error(resp_type, r)
@@ -212,10 +237,8 @@ class StoreClient:
         return r.i64()
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._drop_socket()
 
 
 class PrefixStore:
