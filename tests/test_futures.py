@@ -36,6 +36,16 @@ def test_future_timeout_passthrough() -> None:
     assert out.result(timeout=1.0) == 42
 
 
+def test_future_timeout_cancelled_source() -> None:
+    import concurrent.futures
+
+    fut: Future = Future()
+    out = future_timeout(fut, 5.0)
+    fut.cancel()
+    with pytest.raises((concurrent.futures.CancelledError, TimeoutError)):
+        out.result(timeout=2.0)
+
+
 def test_future_wait() -> None:
     fut: Future = Future()
     fut.set_result("v")

@@ -1,0 +1,901 @@
+"""Reconfigurable host-side communicators for the replica (outer-DP) dimension.
+
+This is the data-plane analog of the reference's reconfigurable
+ProcessGroups (``torchft/process_group.py``), redesigned for TPU: the
+replica dimension lives *outside* XLA programs.  Gradients produced by a
+jit-compiled step are averaged across replica groups by a host-driven
+communicator over DCN/TCP, so membership changes never invalidate compiled
+executables — ``configure()`` swaps the communicator; the gradient divisor is
+a runtime scalar (SURVEY.md §7.3).
+
+Semantics carried over from the reference (SURVEY.md §5.8):
+
+1. ``configure()`` is callable repeatedly, each call rendezvousing under a
+   fresh per-quorum store namespace and fully superseding the previous
+   communicator (``process_group.py:435-471``).
+2. ``abort()`` unblocks in-flight collectives and poisons the communicator
+   until the next ``configure()`` (``process_group.py:875-888``).
+3. Collectives return :class:`~torchft_tpu.work.Work` handles with value
+   chaining (``manager.py:1216-1363``).
+4. Errors are recorded, never raised into the train loop (the Manager votes
+   the step down instead, ``manager.py:487-493``).
+5. Timeouts are userspace and per-operation: an op that exceeds its deadline
+   aborts the communicator rather than killing the process
+   (``process_group.py:714-777``).
+
+The wire tier here (:class:`TCPCommunicator`) is the CPU/"gloo" equivalent
+that runs anywhere; the same interface is implemented by the C++ runtime
+(``native/``) for production DCN use.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import select
+import socket
+import struct
+import threading
+import time
+from abc import ABC, abstractmethod
+from concurrent.futures import Future
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from torchft_tpu.futures import TimerHandle, schedule_timeout
+from torchft_tpu.store import create_store_client
+from torchft_tpu.work import DummyWork, Work
+
+logger = logging.getLogger(__name__)
+
+Buffers = Union[np.ndarray, Sequence[np.ndarray]]
+
+
+class ReduceOp(Enum):
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+
+
+def _reduce_into(op: ReduceOp, acc: np.ndarray, incoming: np.ndarray) -> None:
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        np.add(acc, incoming, out=acc)
+    elif op == ReduceOp.MAX:
+        np.maximum(acc, incoming, out=acc)
+    elif op == ReduceOp.MIN:
+        np.minimum(acc, incoming, out=acc)
+    else:  # pragma: no cover
+        raise ValueError(f"unsupported reduce op {op}")
+
+
+class CommunicatorError(RuntimeError):
+    pass
+
+
+class CommunicatorAborted(CommunicatorError):
+    pass
+
+
+class Communicator(ABC):
+    """Abstract reconfigurable communicator (``process_group.py:131-399``)."""
+
+    @abstractmethod
+    def configure(
+        self,
+        store_addr: str,
+        replica_id: str,
+        rank: int,
+        world_size: int,
+        quorum_id: int = 0,
+        group_rank: int = 0,
+        group_world_size: int = 1,
+        global_ranks: Sequence[int] = (),
+    ) -> None:
+        ...
+
+    @abstractmethod
+    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+        """Reduce ``buffers`` across ranks; the Work's value is the reduced
+        list of arrays (AVG divides by world size)."""
+
+    @abstractmethod
+    def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
+        ...
+
+    @abstractmethod
+    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
+        ...
+
+    @abstractmethod
+    def recv_bytes(self, src: int, tag: int = 0) -> Work:
+        ...
+
+    @abstractmethod
+    def barrier(self) -> Work:
+        ...
+
+    @abstractmethod
+    def abort(self, reason: str = "aborted") -> None:
+        ...
+
+    @abstractmethod
+    def errored(self) -> Optional[Exception]:
+        ...
+
+    @abstractmethod
+    def rank(self) -> int:
+        ...
+
+    @abstractmethod
+    def size(self) -> int:
+        ...
+
+    def set_timeout(self, timeout_s: float) -> None:
+        ...
+
+    def shutdown(self) -> None:
+        ...
+
+
+# ---------------------------------------------------------------------------
+# TCP mesh
+# ---------------------------------------------------------------------------
+
+_HDR = struct.Struct("<QQ")  # payload nbytes, tag
+
+
+class _TcpMesh:
+    """Full mesh of rank-to-rank sockets for one quorum epoch.
+
+    Rendezvous: every rank publishes its listener under ``{prefix}/{rank}``
+    in the store; for each pair (i, j) with i < j, j dials i.  All data ops
+    for the epoch run on a single op thread, so sockets need no locking and
+    collective issue order matches across ranks.
+    """
+
+    def __init__(
+        self,
+        store_addr: str,
+        rank: int,
+        world_size: int,
+        timeout_s: float,
+    ) -> None:
+        self.rank = rank
+        self.world_size = world_size
+        self._aborted = threading.Event()
+        self.peers: Dict[int, socket.socket] = {}
+
+        store = create_store_client(store_addr, timeout=timeout_s)
+
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("0.0.0.0", 0))
+        listener.listen(world_size)
+        port = listener.getsockname()[1]
+        host = socket.gethostname()
+        try:
+            # prefer a dialable address even on hosts with odd hostname setup
+            socket.getaddrinfo(host, port)
+        except socket.gaierror:
+            host = "127.0.0.1"
+        store.set(f"{rank}", f"{host}:{port}".encode())
+
+        expected_inbound = world_size - rank - 1
+        inbound: Dict[int, socket.socket] = {}
+        accept_err: List[BaseException] = []
+
+        def _accept_all() -> None:
+            try:
+                listener.settimeout(timeout_s)
+                for _ in range(expected_inbound):
+                    conn, _ = listener.accept()
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    # hello frame: peer's rank
+                    raw = _recv_exact(conn, 8, self._aborted, timeout_s)
+                    (peer_rank,) = struct.unpack("<Q", raw)
+                    inbound[int(peer_rank)] = conn
+            except BaseException as e:  # noqa: BLE001
+                accept_err.append(e)
+
+        acceptor = threading.Thread(target=_accept_all, daemon=True)
+        acceptor.start()
+
+        try:
+            for peer in range(rank):
+                addr = store.get(f"{peer}", timeout=timeout_s).decode()
+                peer_host, peer_port = addr.rsplit(":", 1)
+                sock = socket.create_connection(
+                    (peer_host, int(peer_port)), timeout=timeout_s
+                )
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.sendall(struct.pack("<Q", rank))
+                self.peers[peer] = sock
+
+            acceptor.join(timeout=timeout_s + 5.0)
+            if accept_err:
+                raise CommunicatorError(
+                    f"rank {rank} rendezvous accept failed: {accept_err[0]}"
+                ) from accept_err[0]
+            if acceptor.is_alive():
+                raise CommunicatorError(f"rank {rank} rendezvous timed out")
+            self.peers.update(inbound)
+        finally:
+            listener.close()
+
+        for sock in self.peers.values():
+            sock.setblocking(False)
+
+    # -- low-level duplex IO -------------------------------------------------
+
+    def abort(self) -> None:
+        self._aborted.set()
+        for sock in self.peers.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _check_abort(self) -> None:
+        if self._aborted.is_set():
+            raise CommunicatorAborted("communicator aborted")
+
+    def exchange(
+        self,
+        sends: List[Tuple[int, int, memoryview]],
+        recvs: List[Tuple[int, int, memoryview]],
+        deadline: float,
+    ) -> None:
+        """Concurrently push ``sends`` and drain ``recvs``.
+
+        ``sends``/``recvs`` are ``(peer_rank, tag, payload_view)`` triples.
+        Concurrent duplex IO (select-driven, non-blocking sockets) is what
+        makes ring steps deadlock-free: every rank sends to its right
+        neighbor while receiving from its left without ordering constraints.
+        """
+        send_state = {}
+        for peer, tag, view in sends:
+            header = _HDR.pack(len(view), tag)
+            send_state[peer] = [memoryview(header), view]
+        recv_state = {}
+        for peer, tag, view in recvs:
+            recv_state[peer] = {
+                "hdr": bytearray(),
+                "view": view,
+                "off": 0,
+                "tag": tag,
+            }
+
+        while send_state or recv_state:
+            self._check_abort()
+            if time.monotonic() > deadline:
+                raise TimeoutError("collective exchange timed out")
+            rlist = [self.peers[p] for p in recv_state]
+            wlist = [self.peers[p] for p in send_state]
+            readable, writable, _ = select.select(rlist, wlist, [], 0.1)
+
+            for sock in writable:
+                peer = next(p for p, s in self.peers.items() if s is sock)
+                bufs = send_state.get(peer)
+                if bufs is None:
+                    continue
+                try:
+                    while bufs:
+                        sent = sock.send(bufs[0])
+                        if sent == len(bufs[0]):
+                            bufs.pop(0)
+                        else:
+                            bufs[0] = bufs[0][sent:]
+                            break
+                except BlockingIOError:
+                    pass
+                except OSError as e:
+                    raise CommunicatorError(f"send to rank {peer} failed: {e}") from e
+                if not bufs:
+                    del send_state[peer]
+
+            for sock in readable:
+                peer = next(p for p, s in self.peers.items() if s is sock)
+                st = recv_state.get(peer)
+                if st is None:
+                    continue
+                try:
+                    if len(st["hdr"]) < _HDR.size:
+                        chunk = sock.recv(_HDR.size - len(st["hdr"]))
+                        if not chunk:
+                            raise CommunicatorError(
+                                f"connection to rank {peer} closed"
+                            )
+                        st["hdr"] += chunk
+                        if len(st["hdr"]) == _HDR.size:
+                            nbytes, tag = _HDR.unpack(bytes(st["hdr"]))
+                            if tag != st["tag"]:
+                                raise CommunicatorError(
+                                    f"tag mismatch from rank {peer}: "
+                                    f"got {tag}, want {st['tag']}"
+                                )
+                            if nbytes != len(st["view"]):
+                                raise CommunicatorError(
+                                    f"size mismatch from rank {peer}: "
+                                    f"got {nbytes}, want {len(st['view'])}"
+                                )
+                    elif st["off"] < len(st["view"]):
+                        n = sock.recv_into(st["view"][st["off"] :])
+                        if n == 0:
+                            raise CommunicatorError(
+                                f"connection to rank {peer} closed"
+                            )
+                        st["off"] += n
+                except BlockingIOError:
+                    continue
+                # complete once the header arrived and the payload (possibly
+                # zero-length) is fully received
+                if len(st["hdr"]) == _HDR.size and st["off"] == len(st["view"]):
+                    del recv_state[peer]
+
+
+def _recv_exact(
+    sock: socket.socket, n: int, aborted: threading.Event, timeout_s: float
+) -> bytes:
+    sock.settimeout(timeout_s)
+    out = b""
+    while len(out) < n:
+        if aborted.is_set():
+            raise CommunicatorAborted("communicator aborted")
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise CommunicatorError("connection closed during recv")
+        out += chunk
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TCPCommunicator
+# ---------------------------------------------------------------------------
+
+
+class TCPCommunicator(Communicator):
+    """Host-driven collectives over TCP with ring allreduce.
+
+    The CPU-anywhere tier (the reference's Gloo analog,
+    ``process_group.py:643-711``) and the semantic model for the DCN tier:
+    bandwidth-optimal ring reduce-scatter + allgather on numpy buffers, all
+    ops serialized on a per-epoch op thread, per-op userspace timeouts that
+    ``abort()`` the communicator on expiry.
+    """
+
+    def __init__(self, timeout_s: float = 60.0) -> None:
+        self._timeout_s = timeout_s
+        self._mesh: Optional[_TcpMesh] = None
+        self._rank = 0
+        self._world_size = 1
+        self._quorum_id = -1
+        self._errored: Optional[Exception] = None
+        self._ops: "queue.Queue[Optional[Tuple[Callable[[], object], Future]]]" = (
+            queue.Queue()
+        )
+        self._op_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._epoch = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def configure(
+        self,
+        store_addr: str,
+        replica_id: str,
+        rank: int,
+        world_size: int,
+        quorum_id: int = 0,
+        group_rank: int = 0,
+        group_world_size: int = 1,
+        global_ranks: Sequence[int] = (),
+    ) -> None:
+        with self._lock:
+            self._teardown_locked(reason="superseded by reconfigure")
+            self._rank = rank
+            self._world_size = world_size
+            self._quorum_id = quorum_id
+            self._errored = None
+            self._epoch += 1
+            if world_size > 1:
+                self._mesh = _TcpMesh(
+                    store_addr, rank, world_size, self._timeout_s
+                )
+            else:
+                self._mesh = None
+            self._ops = queue.Queue()
+            self._op_thread = threading.Thread(
+                target=self._run_ops,
+                args=(self._ops, self._epoch),
+                name=f"tpuft_comm_ops_{self._epoch}",
+                daemon=True,
+            )
+            self._op_thread.start()
+        logger.info(
+            "communicator configured: replica_id=%s rank=%d/%d quorum_id=%d",
+            replica_id,
+            rank,
+            world_size,
+            quorum_id,
+        )
+
+    def _teardown_locked(self, reason: str) -> None:
+        if self._mesh is not None:
+            self._mesh.abort()  # unblocks any op mid-IO with CommunicatorAborted
+            self._mesh = None
+        # fail everything still queued (items the old op thread also races for
+        # just fail against the closed mesh instead — either way they error)
+        try:
+            while True:
+                item = self._ops.get_nowait()
+                if item is not None:
+                    item[1].set_exception(CommunicatorAborted(reason))
+        except queue.Empty:
+            pass
+        if self._op_thread is not None:
+            self._ops.put(None)  # exit sentinel, consumed after any in-flight op
+            self._op_thread = None
+
+    def abort(self, reason: str = "aborted") -> None:
+        """Unblock in-flight collectives and poison until reconfigure."""
+        with self._lock:
+            if self._errored is None:
+                self._errored = CommunicatorAborted(reason)
+            self._teardown_locked(reason=reason)
+        logger.warning("communicator aborted: %s", reason)
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    def shutdown(self) -> None:
+        self.abort("shutdown")
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._world_size
+
+    def set_timeout(self, timeout_s: float) -> None:
+        self._timeout_s = timeout_s
+
+    # -- op submission -------------------------------------------------------
+
+    def _abort_if_epoch(self, epoch: int, reason: str) -> None:
+        # late timers from a superseded epoch must not abort the new mesh
+        with self._lock:
+            if self._epoch != epoch:
+                return
+        self.abort(reason)
+
+    def _run_ops(
+        self,
+        ops: "queue.Queue[Optional[Tuple[Callable[[], object], Future]]]",
+        epoch: int,
+    ) -> None:
+        while True:
+            item = ops.get()
+            if item is None:
+                return
+            fn, fut = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            # Userspace per-op watchdog: a wedged collective aborts the
+            # communicator (unblocking the socket IO) instead of hanging the
+            # train loop or killing the process.
+            timeout_s = self._timeout_s
+            handle: TimerHandle = schedule_timeout(
+                timeout_s,
+                lambda: self._abort_if_epoch(
+                    epoch, f"op timed out after {timeout_s}s"
+                ),
+            )
+            try:
+                result = fn()
+            except BaseException as e:  # noqa: BLE001
+                with self._lock:
+                    if self._epoch == epoch and self._errored is None:
+                        self._errored = (
+                            e if isinstance(e, Exception) else RuntimeError(str(e))
+                        )
+                fut.set_exception(e)
+            else:
+                fut.set_result(result)
+            finally:
+                handle.cancel()
+
+    def _submit(self, make_fn: Callable[["_CommCtx"], Callable[[], object]]) -> Work:
+        # Ops capture an epoch-pinned snapshot of (mesh, rank, ws) so an op
+        # drained late from a superseded queue can never touch the sockets of
+        # a newer epoch.
+        with self._lock:
+            if self._errored is not None:
+                fut: Future = Future()
+                fut.set_exception(self._errored)
+                return Work(fut)
+            if self._op_thread is None:
+                fut = Future()
+                fut.set_exception(
+                    CommunicatorError("communicator not configured")
+                )
+                return Work(fut)
+            ctx = _CommCtx(
+                mesh=self._mesh,
+                rank=self._rank,
+                world_size=self._world_size,
+                timeout_s=self._timeout_s,
+            )
+            fut = Future()
+            self._ops.put((make_fn(ctx), fut))
+            return Work(fut)
+
+    # -- collectives ---------------------------------------------------------
+
+    @staticmethod
+    def _as_list(buffers: Buffers) -> List[np.ndarray]:
+        if isinstance(buffers, np.ndarray):
+            return [buffers]
+        return [np.asarray(b) for b in buffers]
+
+    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+        arrays = self._as_list(buffers)
+        single = isinstance(buffers, np.ndarray)
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                out = _allreduce_sync(ctx, arrays, op)
+                return out[0] if single else out
+
+            return _run
+
+        return self._submit(_make)
+
+    def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
+        arrays = self._as_list(buffers)
+        single = isinstance(buffers, np.ndarray)
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                out = _broadcast_sync(ctx, arrays, root)
+                return out[0] if single else out
+
+            return _run
+
+        return self._submit(_make)
+
+    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
+        view = memoryview(data)
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                mesh = ctx.require_peer(dst)
+                mesh.exchange([(dst, tag, view)], [], ctx.deadline())
+                return len(view)
+
+            return _run
+
+        return self._submit(_make)
+
+    def recv_bytes(self, src: int, tag: int = 0, nbytes: Optional[int] = None) -> Work:
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                mesh = ctx.require_peer(src)
+                deadline = ctx.deadline()
+                if nbytes is not None:
+                    buf = bytearray(nbytes)
+                    mesh.exchange([], [(src, tag, memoryview(buf))], deadline)
+                    return bytes(buf)
+                # length-prefixed variant: peer sends an 8-byte length first
+                lenbuf = bytearray(8)
+                mesh.exchange([], [(src, tag, memoryview(lenbuf))], deadline)
+                (n,) = struct.unpack("<Q", bytes(lenbuf))
+                buf = bytearray(n)
+                mesh.exchange([], [(src, tag + 1, memoryview(buf))], deadline)
+                return bytes(buf)
+
+            return _run
+
+        return self._submit(_make)
+
+    def send_bytes_framed(self, data: bytes, dst: int, tag: int = 0) -> Work:
+        """Length-prefixed pair for :meth:`recv_bytes` without ``nbytes``."""
+        header = struct.pack("<Q", len(data))
+        view = memoryview(data)
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                mesh = ctx.require_peer(dst)
+                deadline = ctx.deadline()
+                mesh.exchange([(dst, tag, memoryview(header))], [], deadline)
+                mesh.exchange([(dst, tag + 1, view)], [], deadline)
+                return len(view)
+
+            return _run
+
+        return self._submit(_make)
+
+    def barrier(self) -> Work:
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                _allreduce_sync(ctx, [np.zeros(1, dtype=np.float32)], ReduceOp.SUM)
+                return None
+
+            return _run
+
+        return self._submit(_make)
+
+
+class _CommCtx:
+    """Epoch-pinned op context: the mesh and layout captured at submit time."""
+
+    __slots__ = ("mesh", "rank", "world_size", "timeout_s")
+
+    def __init__(
+        self,
+        mesh: Optional[_TcpMesh],
+        rank: int,
+        world_size: int,
+        timeout_s: float,
+    ) -> None:
+        self.mesh = mesh
+        self.rank = rank
+        self.world_size = world_size
+        self.timeout_s = timeout_s
+
+    def deadline(self) -> float:
+        return time.monotonic() + self.timeout_s
+
+    def require_peer(self, peer: int) -> _TcpMesh:
+        if self.mesh is None or peer not in self.mesh.peers:
+            raise CommunicatorError(f"no peer {peer} in communicator")
+        return self.mesh
+
+
+def _allreduce_sync(
+    ctx: _CommCtx, arrays: List[np.ndarray], op: ReduceOp
+) -> List[np.ndarray]:
+    ws = ctx.world_size
+    out = [np.array(a, copy=True) for a in arrays]
+    if ws > 1:
+        assert ctx.mesh is not None
+        # flatten into one contiguous buffer: one ring instead of many
+        single_contig = len(out) == 1 and out[0].flags.c_contiguous
+        flat = (
+            out[0].reshape(-1)
+            if single_contig
+            else np.concatenate([a.reshape(-1) for a in out])
+        )
+        _ring_allreduce(ctx, flat, op)
+        if single_contig:
+            out[0] = flat.reshape(out[0].shape)
+        else:
+            offset = 0
+            for i, a in enumerate(out):
+                n = a.size
+                out[i] = flat[offset : offset + n].reshape(a.shape)
+                offset += n
+    if op == ReduceOp.AVG:
+        for a in out:
+            if np.issubdtype(a.dtype, np.inexact):
+                np.divide(a, ws, out=a)
+            else:
+                a //= ws
+    return out
+
+
+def _ring_allreduce(ctx: _CommCtx, flat: np.ndarray, op: ReduceOp) -> None:
+    """In-place bandwidth-optimal ring allreduce.
+
+    Reduce-scatter then allgather, ws-1 steps each; every step exchanges one
+    chunk with both neighbors concurrently via duplex IO (deadlock-free even
+    at world size 2, where both directions share one socket).
+    """
+    mesh = ctx.mesh
+    assert mesh is not None
+    ws, rank = ctx.world_size, ctx.rank
+    right = (rank + 1) % ws
+    left = (rank - 1) % ws
+    deadline = ctx.deadline()
+
+    bounds = [0]
+    base, extra = divmod(flat.size, ws)
+    for i in range(ws):
+        bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+
+    def chunk(i: int) -> np.ndarray:
+        i %= ws
+        return flat[bounds[i] : bounds[i + 1]]
+
+    scratch = np.empty(base + (1 if extra else 0), dtype=flat.dtype)
+
+    for step in range(ws - 1):
+        send_idx = (rank - step) % ws
+        recv_idx = (rank - step - 1) % ws
+        send_chunk = chunk(send_idx)
+        recv_buf = scratch[: chunk(recv_idx).size]
+        mesh.exchange(
+            [(right, 1000 + step, memoryview(send_chunk).cast("B"))],
+            [(left, 1000 + step, memoryview(recv_buf).cast("B"))],
+            deadline,
+        )
+        _reduce_into(op, chunk(recv_idx), recv_buf)
+
+    for step in range(ws - 1):
+        send_idx = (rank + 1 - step) % ws
+        recv_idx = (rank - step) % ws
+        mesh.exchange(
+            [(right, 2000 + step, memoryview(chunk(send_idx)).cast("B"))],
+            [(left, 2000 + step, memoryview(chunk(recv_idx)).cast("B"))],
+            deadline,
+        )
+
+
+def _broadcast_sync(ctx: _CommCtx, arrays: List[np.ndarray], root: int) -> List[np.ndarray]:
+    ws = ctx.world_size
+    out = [np.ascontiguousarray(a) for a in arrays]
+    if ws == 1:
+        return out
+    mesh = ctx.mesh
+    assert mesh is not None
+    deadline = ctx.deadline()
+    if ctx.rank == root:
+        for i, a in enumerate(out):
+            view = memoryview(a).cast("B")
+            sends = [(p, 3000 + i, view) for p in mesh.peers]
+            mesh.exchange(sends, [], deadline)
+    else:
+        for i, a in enumerate(out):
+            mesh.exchange(
+                [], [(root, 3000 + i, memoryview(a).cast("B"))], deadline
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Test / adapter communicators
+# ---------------------------------------------------------------------------
+
+
+class DummyCommunicator(Communicator):
+    """World-size-1 no-op communicator (``process_group.py:1005-1134``):
+    returns inputs unchanged; soaks up wrapper init in tests."""
+
+    def __init__(self, rank: int = 0, world_size: int = 1) -> None:
+        self._rank = rank
+        self._world_size = world_size
+        self.configure_count = 0
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int, **kw) -> None:  # type: ignore[override]
+        self._rank = rank
+        self._world_size = world_size
+        self.configure_count += 1
+
+    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+        return DummyWork(buffers)
+
+    def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
+        return DummyWork(buffers)
+
+    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
+        return DummyWork(len(data))
+
+    def recv_bytes(self, src: int, tag: int = 0) -> Work:
+        return DummyWork(b"")
+
+    def barrier(self) -> Work:
+        return DummyWork(None)
+
+    def abort(self, reason: str = "aborted") -> None:
+        pass
+
+    def errored(self) -> Optional[Exception]:
+        return None
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._world_size
+
+
+class FakeCommunicatorWrapper(Communicator):
+    """Error-injection wrapper for tests (``process_group.py:1252-1317``):
+    ``report_future_error`` makes the next collective fail."""
+
+    def __init__(self, comm: Communicator) -> None:
+        self._comm = comm
+        self._next_error: Optional[Exception] = None
+        self._errored: Optional[Exception] = None
+
+    def report_future_error(self, err: Exception) -> None:
+        self._next_error = err
+
+    def _maybe_fail(self) -> Optional[Work]:
+        if self._next_error is not None:
+            err, self._next_error = self._next_error, None
+            self._errored = err
+            fut: Future = Future()
+            fut.set_exception(err)
+            return Work(fut)
+        return None
+
+    def configure(self, *args, **kwargs) -> None:  # type: ignore[override]
+        self._errored = None
+        self._comm.configure(*args, **kwargs)
+
+    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._maybe_fail() or self._comm.allreduce(buffers, op)
+
+    def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
+        return self._maybe_fail() or self._comm.broadcast(buffers, root)
+
+    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
+        return self._maybe_fail() or self._comm.send_bytes(data, dst, tag)
+
+    def recv_bytes(self, src: int, tag: int = 0) -> Work:
+        return self._maybe_fail() or self._comm.recv_bytes(src, tag)
+
+    def barrier(self) -> Work:
+        return self._maybe_fail() or self._comm.barrier()
+
+    def abort(self, reason: str = "aborted") -> None:
+        self._comm.abort(reason)
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored or self._comm.errored()
+
+    def rank(self) -> int:
+        return self._comm.rank()
+
+    def size(self) -> int:
+        return self._comm.size()
+
+    def set_timeout(self, timeout_s: float) -> None:
+        self._comm.set_timeout(timeout_s)
+
+    def shutdown(self) -> None:
+        self._comm.shutdown()
+
+
+class ManagedCommunicator(Communicator):
+    """Routes collectives through a Manager so unmodified data-parallel code
+    sees fault-tolerant semantics transparently
+    (``process_group.py:1320-1353``): ``allreduce`` goes through
+    ``manager.allreduce`` (error-swallowing, participation-aware) and
+    ``size()`` reports the participating world size."""
+
+    def __init__(self, manager) -> None:  # type: ignore[no-untyped-def]
+        self._manager = manager
+
+    def configure(self, *args, **kwargs) -> None:  # type: ignore[override]
+        raise RuntimeError("ManagedCommunicator is configured by its Manager")
+
+    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._manager.allreduce(buffers)
+
+    def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
+        return self._manager._comm.broadcast(buffers, root)
+
+    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
+        return self._manager._comm.send_bytes(data, dst, tag)
+
+    def recv_bytes(self, src: int, tag: int = 0) -> Work:
+        return self._manager._comm.recv_bytes(src, tag)
+
+    def barrier(self) -> Work:
+        return self._manager._comm.barrier()
+
+    def abort(self, reason: str = "aborted") -> None:
+        self._manager._comm.abort(reason)
+
+    def errored(self) -> Optional[Exception]:
+        return self._manager._comm.errored()
+
+    def rank(self) -> int:
+        return self._manager.participating_rank() or 0
+
+    def size(self) -> int:
+        return self._manager.num_participants()

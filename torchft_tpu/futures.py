@@ -152,8 +152,15 @@ def future_timeout(fut: "Future[Any]", timeout_s: float) -> "Future[Any]":
         handle.cancel()
         if out.done():
             return
-        err = f.exception()
         try:
+            if f.cancelled():
+                out.cancel()
+                # cancel() on an un-started Future resolves it; if something
+                # already set it running, surface cancellation as an error
+                if not out.done():
+                    out.set_exception(TimeoutError("source future was cancelled"))
+                return
+            err = f.exception()
             if err is not None:
                 out.set_exception(err)
             else:

@@ -44,8 +44,10 @@ from torchft_tpu.wire import (
     Quorum,
     QuorumMember,
     Reader,
+    RpcClient,
     WireError,
     Writer,
+    configure_server_socket,
     connect,
     raise_if_error,
     recv_frame,
@@ -281,7 +283,7 @@ class LighthouseServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            configure_server_socket(conn)
             threading.Thread(
                 target=self._handle_conn,
                 args=(conn,),
@@ -490,39 +492,11 @@ class LighthouseServer:
         )
 
 
-class LighthouseClient:
+class LighthouseClient(RpcClient):
     """Client for :class:`LighthouseServer` (pyo3 analog ``src/lib.rs:486-594``)."""
 
     def __init__(self, addr: str, connect_timeout: float = 60.0) -> None:
-        self._addr = addr
-        self._connect_timeout = connect_timeout
-        self._lock = threading.Lock()
-        self._sock: Optional[socket.socket] = connect(addr, connect_timeout)
-
-    def _drop_socket(self) -> None:
-        # A late response after a client-side timeout would mispair with the
-        # next rpc; drop and re-dial instead.
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
-
-    def _call(self, msg_type: MsgType, payload: bytes, timeout: float) -> Tuple[int, Reader]:
-        with self._lock:
-            if self._sock is None:
-                self._sock = connect(self._addr, self._connect_timeout)
-            self._sock.settimeout(timeout)
-            try:
-                send_frame(self._sock, msg_type, payload)
-                return recv_frame(self._sock)
-            except socket.timeout as e:
-                self._drop_socket()
-                raise TimeoutError(f"lighthouse rpc {msg_type.name} timed out") from e
-            except (ConnectionError, OSError):
-                self._drop_socket()
-                raise
+        super().__init__(addr, connect_timeout=connect_timeout)
 
     def quorum(
         self,
@@ -554,24 +528,20 @@ class LighthouseClient:
         w = Writer()
         member.encode(w)
         w.u64(int(timeout * 1000))
-        msg_type, r = self._call(MsgType.LH_QUORUM_REQ, w.payload(), timeout + 5.0)
+        msg_type, r = self.call(MsgType.LH_QUORUM_REQ, w.payload(), timeout)
         raise_if_error(msg_type, r)
         return Quorum.decode(r)
 
     def heartbeat(self, replica_id: str, timeout: float = 5.0) -> None:
-        msg_type, r = self._call(
+        msg_type, r = self.call(
             MsgType.LH_HEARTBEAT_REQ, Writer().string(replica_id).payload(), timeout
         )
         raise_if_error(msg_type, r)
 
     def status(self, timeout: float = 5.0) -> dict:
-        msg_type, r = self._call(MsgType.LH_STATUS_REQ, b"", timeout)
+        msg_type, r = self.call(MsgType.LH_STATUS_REQ, b"", timeout)
         raise_if_error(msg_type, r)
         return json.loads(r.string())
-
-    def close(self) -> None:
-        with self._lock:
-            self._drop_socket()
 
 
 def lighthouse_main(argv: Optional[List[str]] = None) -> None:

@@ -42,9 +42,10 @@ from torchft_tpu.wire import (
     Quorum,
     QuorumMember,
     Reader,
+    RpcClient,
     WireError,
     Writer,
-    connect,
+    configure_server_socket,
     raise_if_error,
     recv_frame,
     send_error,
@@ -250,7 +251,7 @@ class ManagerServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            configure_server_socket(conn)
             threading.Thread(
                 target=self._handle_conn,
                 args=(conn,),
@@ -478,40 +479,15 @@ class ManagerServer:
         )
 
 
-class ManagerClient:
+class ManagerClient(RpcClient):
     """Client used by every local rank to reach its group's ManagerServer
     (pyo3 analog ``src/lib.rs:153-282``)."""
 
     def __init__(self, addr: str, connect_timeout: float = 10.0) -> None:
-        self._addr = addr
-        self._connect_timeout = connect_timeout
-        self._lock = threading.Lock()
-        self._sock: Optional[socket.socket] = connect(addr, connect_timeout)
-
-    def _drop_socket(self) -> None:
-        # A late response after a client-side timeout would mispair with the
-        # next rpc; drop and re-dial instead.
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        super().__init__(addr, connect_timeout=connect_timeout)
 
     def _call(self, msg_type: MsgType, payload: bytes, timeout: float) -> Tuple[int, Reader]:
-        with self._lock:
-            if self._sock is None:
-                self._sock = connect(self._addr, self._connect_timeout)
-            self._sock.settimeout(timeout + 5.0)
-            try:
-                send_frame(self._sock, msg_type, payload)
-                return recv_frame(self._sock)
-            except socket.timeout as e:
-                self._drop_socket()
-                raise TimeoutError(f"manager rpc {msg_type.name} timed out") from e
-            except (ConnectionError, OSError):
-                self._drop_socket()
-                raise
+        return self.call(msg_type, payload, timeout)
 
     def _quorum(
         self,
@@ -561,7 +537,3 @@ class ManagerClient:
     def kill(self, msg: str, timeout: float = 10.0) -> None:
         msg_type, r = self._call(MsgType.MGR_KILL_REQ, Writer().string(msg).payload(), timeout)
         raise_if_error(msg_type, r)
-
-    def close(self) -> None:
-        with self._lock:
-            self._drop_socket()

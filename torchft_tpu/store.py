@@ -27,9 +27,11 @@ from torchft_tpu.wire import (
     ErrCode,
     MsgType,
     Reader,
+    RpcClient,
     Writer,
     WireError,
-    connect,
+    configure_server_socket,
+    raise_if_error,
     recv_frame,
     send_error,
     send_frame,
@@ -75,7 +77,7 @@ class StoreServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            configure_server_socket(conn)
             threading.Thread(
                 target=self._handle, args=(conn,), name="tpuft_store_conn", daemon=True
             ).start()
@@ -160,7 +162,7 @@ class StoreServer:
             pass
 
 
-class StoreClient:
+class StoreClient(RpcClient):
     """Client for :class:`StoreServer`.
 
     ``timeout`` bounds every operation including wait-for-key gets, matching
@@ -169,46 +171,14 @@ class StoreClient:
     """
 
     def __init__(self, addr: str, timeout: float = 60.0) -> None:
-        self._addr = addr
+        super().__init__(addr, connect_timeout=timeout)
         self._timeout = timeout
-        self._lock = threading.Lock()
-        self._sock: Optional[socket.socket] = connect(addr, timeout)
-
-    @property
-    def addr(self) -> str:
-        return self._addr
-
-    def _drop_socket(self) -> None:
-        # After a client-side timeout the server's late response may still be
-        # in flight; reusing the socket would mispair it with the next rpc.
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
 
     def _call(
         self, msg_type: MsgType, payload: bytes, timeout: Optional[float] = None
     ) -> Reader:
         budget = self._timeout if timeout is None else timeout
-        with self._lock:
-            if self._sock is None:
-                self._sock = connect(self._addr, self._timeout)
-            # headroom over the server-side deadline so the server's timeout
-            # error reaches us rather than a raw socket timeout
-            self._sock.settimeout(budget + 5.0)
-            try:
-                send_frame(self._sock, msg_type, payload)
-                resp_type, r = recv_frame(self._sock)
-            except socket.timeout as e:
-                self._drop_socket()
-                raise TimeoutError(f"store rpc {msg_type.name} timed out") from e
-            except (ConnectionError, OSError):
-                self._drop_socket()
-                raise
-        from torchft_tpu.wire import raise_if_error
-
+        resp_type, r = self.call(msg_type, payload, budget)
         raise_if_error(resp_type, r)
         return r
 
@@ -235,10 +205,6 @@ class StoreClient:
     def delete_prefix(self, prefix: str) -> int:
         r = self._call(MsgType.STORE_DELETE, Writer().string(prefix).payload())
         return r.i64()
-
-    def close(self) -> None:
-        with self._lock:
-            self._drop_socket()
 
 
 class PrefixStore:

@@ -392,3 +392,66 @@ def connect(addr: str, timeout: float) -> socket.socket:
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
     return sock
+
+
+def configure_server_socket(conn: socket.socket) -> None:
+    """Options for server-accepted connections: keepalive mirrors connect()
+    so a silently-dead peer can't park a handler thread forever."""
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+
+
+class RpcClient:
+    """Single-socket request/response client with reconnect-on-timeout.
+
+    Shared base for the store / lighthouse / manager clients.  After a
+    client-side timeout the server's late response may still arrive; reusing
+    the socket would mispair it with the next rpc, so the socket is dropped
+    and re-dialed on the next call.  ``headroom_s`` keeps the client deadline
+    behind the server-honored deadline so the server's TIMEOUT error frame
+    (the analog of honoring ``grpc-timeout`` server-side) wins the race.
+    """
+
+    def __init__(
+        self, addr: str, connect_timeout: float, headroom_s: float = 5.0
+    ) -> None:
+        import threading
+
+        self._addr = addr
+        self._connect_timeout = connect_timeout
+        self._headroom_s = headroom_s
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = connect(addr, connect_timeout)
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    def _drop_socket(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def call(self, msg_type: int, payload: bytes, timeout: float) -> tuple[int, Reader]:
+        """One rpc round-trip; raises ``TimeoutError`` on deadline and drops
+        the socket on any transport fault."""
+        with self._lock:
+            if self._sock is None:
+                self._sock = connect(self._addr, self._connect_timeout)
+            self._sock.settimeout(timeout + self._headroom_s)
+            try:
+                send_frame(self._sock, msg_type, payload)
+                return recv_frame(self._sock)
+            except socket.timeout as e:
+                self._drop_socket()
+                raise TimeoutError(f"rpc 0x{msg_type:x} to {self._addr} timed out") from e
+            except (ConnectionError, OSError, WireError):
+                self._drop_socket()
+                raise
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop_socket()
