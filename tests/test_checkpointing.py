@@ -1,0 +1,145 @@
+"""Serialization + HTTP transport conformance tests
+(reference: ``torchft/checkpointing/transport_test.py`` ABC suite)."""
+
+import io
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.checkpointing.serialization import (
+    dumps_pytree,
+    load_pytree,
+    loads_pytree,
+    save_pytree,
+)
+
+
+def _state():
+    return {
+        "user": {
+            "model": {
+                "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                "b": jnp.ones(4, dtype=jnp.bfloat16),
+                "layers": [jnp.zeros((2, 2)), np.full(3, 7.0)],
+            },
+            "opt": {"mu": jnp.arange(5, dtype=jnp.float32), "count": 3},
+            "meta": ("tag", 1.5, None),
+        },
+        "torchft": {"step": 7, "batches_committed": 21},
+    }
+
+
+def _assert_state_equal(a, b) -> None:
+    assert a["torchft"] == b["torchft"]
+    au, bu = a["user"], b["user"]
+    np.testing.assert_array_equal(np.asarray(au["model"]["w"]), bu["model"]["w"])
+    np.testing.assert_array_equal(np.asarray(au["model"]["b"]), bu["model"]["b"])
+    np.testing.assert_array_equal(
+        np.asarray(au["model"]["layers"][0]), bu["model"]["layers"][0]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(au["model"]["layers"][1]), bu["model"]["layers"][1]
+    )
+    np.testing.assert_array_equal(np.asarray(au["opt"]["mu"]), bu["opt"]["mu"])
+    assert au["opt"]["count"] == bu["opt"]["count"]
+    assert au["meta"] == bu["meta"]
+
+
+class TestSerialization:
+    def test_roundtrip(self) -> None:
+        state = _state()
+        blob = dumps_pytree(state)
+        restored = loads_pytree(blob)
+        _assert_state_equal(state, restored)
+
+    def test_bf16_dtype_preserved(self) -> None:
+        state = {"x": jnp.ones(3, dtype=jnp.bfloat16)}
+        restored = loads_pytree(dumps_pytree(state))
+        assert restored["x"].dtype.name == "bfloat16"
+
+    def test_streaming(self) -> None:
+        state = {"big": np.random.default_rng(0).normal(size=100_000)}
+        buf = io.BytesIO()
+        save_pytree(state, buf)
+        buf.seek(0)
+        restored = load_pytree(buf)
+        np.testing.assert_array_equal(restored["big"], state["big"])
+
+    def test_bad_magic(self) -> None:
+        with pytest.raises(ValueError, match="magic"):
+            loads_pytree(b"NOPE" + b"\x00" * 100)
+
+
+@pytest.mark.parametrize("num_chunks", [0, 4])
+class TestHTTPTransport:
+    def test_roundtrip(self, num_chunks) -> None:
+        sender = HTTPTransport(timeout=10.0, num_chunks=num_chunks)
+        receiver = HTTPTransport(timeout=10.0, num_chunks=num_chunks)
+        try:
+            state = _state()
+            sender.send_checkpoint([1], step=7, state_dict=state, timeout=10.0)
+            fetched = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=7, timeout=10.0
+            )
+            _assert_state_equal(state, fetched)
+        finally:
+            sender.shutdown()
+            receiver.shutdown()
+
+    def test_wrong_step_404(self, num_chunks) -> None:
+        sender = HTTPTransport(timeout=2.0, num_chunks=num_chunks)
+        receiver = HTTPTransport(timeout=2.0, num_chunks=num_chunks)
+        try:
+            sender.send_checkpoint([1], step=3, state_dict={"a": 1}, timeout=5.0)
+            with pytest.raises(Exception):
+                receiver.recv_checkpoint(
+                    src_rank=0, metadata=sender.metadata(), step=9, timeout=2.0
+                )
+        finally:
+            sender.shutdown()
+            receiver.shutdown()
+
+    def test_disallow_then_resend(self, num_chunks) -> None:
+        sender = HTTPTransport(timeout=2.0, num_chunks=num_chunks)
+        receiver = HTTPTransport(timeout=2.0, num_chunks=num_chunks)
+        try:
+            sender.send_checkpoint([1], step=1, state_dict={"a": 1}, timeout=5.0)
+            sender.disallow_checkpoint()
+            with pytest.raises(Exception):
+                receiver.recv_checkpoint(
+                    src_rank=0, metadata=sender.metadata(), step=1, timeout=1.0
+                )
+            sender.send_checkpoint([1], step=2, state_dict={"a": 2}, timeout=5.0)
+            out = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=2, timeout=5.0
+            )
+            assert out == {"a": 2}
+        finally:
+            sender.shutdown()
+            receiver.shutdown()
+
+    def test_receiver_can_wait_for_staging(self, num_chunks) -> None:
+        """A healing peer that races ahead of send_checkpoint blocks until
+        the sender stages rather than failing."""
+        sender = HTTPTransport(timeout=10.0, num_chunks=num_chunks)
+        receiver = HTTPTransport(timeout=10.0, num_chunks=num_chunks)
+        try:
+            def _stage() -> None:
+                import time
+
+                time.sleep(0.3)
+                sender.send_checkpoint([1], step=5, state_dict={"k": 9}, timeout=5.0)
+
+            t = threading.Thread(target=_stage)
+            t.start()
+            out = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=5, timeout=10.0
+            )
+            assert out == {"k": 9}
+            t.join()
+        finally:
+            sender.shutdown()
+            receiver.shutdown()

@@ -136,24 +136,43 @@ def test_send_recv_bytes(store) -> None:
         if rank == 0:
             comm.send_bytes(b"hello from zero", dst=1, tag=7).wait(timeout=30.0)
             return None
-        return comm.recv_bytes(src=0, tag=7, nbytes=15).wait(timeout=30.0)
+        return comm.recv_bytes(src=0, tag=7).wait(timeout=30.0)
 
     results = _run_ranks(store, world_size, _fn)
     assert results[1] == b"hello from zero"
 
 
-def test_send_recv_framed(store) -> None:
+def test_send_recv_large(store) -> None:
     world_size = 2
     payload = b"x" * 100_000
 
     def _fn(comm, rank):
         if rank == 0:
-            comm.send_bytes_framed(payload, dst=1, tag=40).wait(timeout=30.0)
+            comm.send_bytes(payload, dst=1, tag=40).wait(timeout=30.0)
             return None
         return comm.recv_bytes(src=0, tag=40).wait(timeout=30.0)
 
     results = _run_ranks(store, world_size, _fn)
     assert results[1] == payload
+
+
+def test_allreduce_mixed_dtypes_preserved(store) -> None:
+    """Mixed dtypes must NOT promote (f32+i64 would concatenate to f64)."""
+    world_size = 2
+
+    def _fn(comm, rank):
+        bufs = [
+            np.full(5, float(rank + 1), dtype=np.float32),
+            np.full(3, rank + 1, dtype=np.int64),
+        ]
+        return comm.allreduce(bufs, ReduceOp.SUM).wait(timeout=30.0)
+
+    results = _run_ranks(store, world_size, _fn)
+    for res in results:
+        assert res[0].dtype == np.float32
+        assert res[1].dtype == np.int64
+        np.testing.assert_allclose(res[0], np.full(5, 3.0))
+        np.testing.assert_array_equal(res[1], np.full(3, 3))
 
 
 def test_barrier(store) -> None:

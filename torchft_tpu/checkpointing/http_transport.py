@@ -1,0 +1,173 @@
+"""HTTP checkpoint transport: per-replica HTTP server streaming live weights.
+
+Twin of the reference transport (``torchft/checkpointing/http_transport.py``):
+every worker runs a threading HTTP server; ``metadata()`` is its URL; healing
+peers fetch ``/checkpoint/{step}/full`` (or ``/checkpoint/{step}/{i}`` chunks
+in parallel); the RWLock freezes the state dict while it is being serialized
+so the train loop can't mutate weights mid-transfer
+(``http_transport.py:181-202``).
+
+Divergence from the reference: the staged state is serialized once into
+chunk buffers at ``send_checkpoint`` time (jax arrays must be device_get
+anyway, so "staging to CPU" and "serializing" collapse into one step);
+serving threads then just stream bytes, holding no lock against training.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, TypeVar
+from urllib.request import urlopen
+
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing.serialization import (
+    dumps_pytree,
+    load_pytree,
+    loads_pytree,
+)
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+class HTTPTransport(CheckpointTransport[T]):
+    """Serve/fetch live checkpoints over HTTP.
+
+    Args:
+        timeout: default deadline for fetches.
+        num_chunks: >0 splits the serialized state into N byte-ranges fetched
+            by parallel threads (``http_transport.py:219-241``); 0 streams
+            one ``full`` payload.
+    """
+
+    def __init__(self, timeout: float = 60.0, num_chunks: int = 0) -> None:
+        self._timeout = timeout
+        self._num_chunks = num_chunks
+        self._lock = RWLock(timeout=timeout)
+        self._staged: Optional[Dict[str, object]] = None  # step, chunks
+        self._allowed = threading.Event()
+
+        transport = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt: str, *args: object) -> None:
+                logger.debug("http_transport: " + fmt, *args)
+
+            def do_GET(self) -> None:
+                parts = [p for p in self.path.split("/") if p]
+                # /checkpoint/{step}/{full|i}
+                if len(parts) != 3 or parts[0] != "checkpoint":
+                    self.send_error(404, "unknown path")
+                    return
+                # Wait for a checkpoint to be staged rather than 404ing a
+                # peer that raced ahead (the quorum guarantees it's coming).
+                if not transport._allowed.wait(timeout=transport._timeout):
+                    self.send_error(503, "no checkpoint staged")
+                    return
+                with transport._lock.r_lock():
+                    staged = transport._staged
+                    if staged is None:
+                        self.send_error(503, "no checkpoint staged")
+                        return
+                    step = int(parts[1])
+                    if staged["step"] != step:
+                        self.send_error(
+                            404,
+                            f"staged step {staged['step']} != requested {step}",
+                        )
+                        return
+                    chunks: List[bytes] = staged["chunks"]  # type: ignore[assignment]
+                    if parts[2] == "full":
+                        payload = b"".join(chunks)
+                    else:
+                        idx = int(parts[2])
+                        if idx >= len(chunks):
+                            self.send_error(404, f"no chunk {idx}")
+                            return
+                        payload = chunks[idx]
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("X-Num-Chunks", str(len(chunks)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server(("0.0.0.0", 0), _Handler)
+        self._port: int = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tpuft_http_transport",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def metadata(self) -> str:
+        return f"http://{socket.gethostname()}:{self._port}"
+
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: T, timeout: float
+    ) -> None:
+        """Serialize once under the write lock, then serve lock-free."""
+        blob = dumps_pytree(state_dict)
+        if self._num_chunks > 0:
+            n = self._num_chunks
+            size = max(1, (len(blob) + n - 1) // n)
+            chunks = [blob[i : i + size] for i in range(0, len(blob), size)] or [b""]
+        else:
+            chunks = [blob]
+        with self._lock.w_lock(timeout=timeout):
+            self._staged = {"step": step, "chunks": chunks}
+        self._allowed.set()
+
+    def disallow_checkpoint(self) -> None:
+        self._allowed.clear()
+        with self._lock.w_lock():
+            self._staged = None
+
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: float
+    ) -> T:
+        base = f"{metadata}/checkpoint/{step}"
+        with urlopen(f"{base}/full" if self._num_chunks == 0 else f"{base}/0", timeout=timeout) as resp:
+            if self._num_chunks == 0:
+                return load_pytree(resp)  # type: ignore[return-value]
+            first = resp.read()
+            total = int(resp.headers.get("X-Num-Chunks", "1"))
+
+        chunks: List[Optional[bytes]] = [None] * total
+        chunks[0] = first
+
+        def _fetch(i: int) -> None:
+            with urlopen(f"{base}/{i}", timeout=timeout) as r:
+                chunks[i] = r.read()
+
+        threads = [
+            threading.Thread(target=_fetch, args=(i,)) for i in range(1, total)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+        if any(c is None for c in chunks):
+            raise TimeoutError("chunked checkpoint fetch timed out")
+        return loads_pytree(b"".join(chunks))  # type: ignore[arg-type]
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._server.shutdown()
+        self._server.server_close()

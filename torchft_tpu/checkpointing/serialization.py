@@ -1,0 +1,189 @@
+"""Streaming serialization for pytrees of jax/numpy arrays.
+
+The reference streams ``torch.save``-serialized state dicts
+(``torchft/checkpointing/_serialization.py:14-39``); here the state is an
+arbitrary pytree whose array leaves are jax Arrays or numpy arrays.  The
+format separates the (pickled) tree skeleton from raw array payloads so
+multi-MB tensors stream as straight buffer copies with no pickle overhead:
+
+``TFTC`` magic + version, skeleton (pickle with array leaves replaced by
+placeholders), then per-array: dtype tag, shape, raw little-endian bytes.
+
+Like the reference (which pickles tensor metadata over its transports,
+``pg_transport.py:32-146``), the skeleton uses pickle and therefore assumes
+the same trust model: checkpoint peers are other replicas of the same job
+inside the cluster, never untrusted parties.
+
+jax arrays are materialized to host numpy on save (``jax.device_get``) and
+returned as numpy on load — the consumer decides placement/sharding
+(``jax.device_put`` with a NamedSharding) because the healing replica's mesh
+layout, not the sender's, governs where shards land.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from dataclasses import dataclass
+from typing import Any, BinaryIO, List, Tuple
+
+import numpy as np
+
+MAGIC = b"TFTC\x01"
+
+
+def as_byte_view(arr: np.ndarray) -> memoryview:
+    """Raw little-endian bytes of a contiguous array; works for extension
+    dtypes (bfloat16, fp8) that reject ``memoryview.cast``."""
+    return memoryview(np.ascontiguousarray(arr).reshape(-1).view(np.uint8))
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # extension dtypes (bfloat16, float8_*) register via ml_dtypes
+        import ml_dtypes  # noqa: F401
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclass
+class _ArrayPlaceholder:
+    index: int
+    dtype: str
+    shape: Tuple[int, ...]
+
+
+def _is_array_leaf(x: Any) -> bool:
+    if isinstance(x, np.ndarray):
+        return True
+    # jax.Array without importing jax at module import time
+    return type(x).__module__.startswith("jax") and hasattr(x, "__array__")
+
+
+def _extract_arrays(obj: Any, arrays: List[np.ndarray]) -> Any:
+    """Deep-copy the container skeleton, swapping array leaves for
+    placeholders (handles dict/list/tuple; other types pickle as-is)."""
+    if _is_array_leaf(obj):
+        arr = np.asarray(obj)
+        # dtype.name (not .str) so extension dtypes like bfloat16 round-trip
+        placeholder = _ArrayPlaceholder(
+            index=len(arrays), dtype=arr.dtype.name, shape=arr.shape
+        )
+        arrays.append(arr)
+        return placeholder
+    if isinstance(obj, dict):
+        return {k: _extract_arrays(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        mapped = [_extract_arrays(v, arrays) for v in obj]
+        if isinstance(obj, list):
+            return mapped
+        # preserve NamedTuple types (optax optimizer states are namedtuples)
+        if hasattr(obj, "_fields"):
+            return type(obj)(*mapped)
+        return tuple(mapped)
+    return obj
+
+
+def _restore_arrays(obj: Any, arrays: List[np.ndarray]) -> Any:
+    if isinstance(obj, _ArrayPlaceholder):
+        return arrays[obj.index]
+    if isinstance(obj, dict):
+        return {k: _restore_arrays(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        mapped = [_restore_arrays(v, arrays) for v in obj]
+        if isinstance(obj, list):
+            return mapped
+        if hasattr(obj, "_fields"):
+            return type(obj)(*mapped)
+        return tuple(mapped)
+    return obj
+
+
+def save_pytree(state: Any, stream: BinaryIO) -> None:
+    arrays: List[np.ndarray] = []
+    skeleton = _extract_arrays(state, arrays)
+    payload = pickle.dumps(skeleton, protocol=pickle.HIGHEST_PROTOCOL)
+
+    stream.write(MAGIC)
+    stream.write(struct.pack("<I", len(payload)))
+    stream.write(payload)
+    stream.write(struct.pack("<I", len(arrays)))
+    for arr in arrays:
+        stream.write(struct.pack("<Q", arr.nbytes))
+        stream.write(as_byte_view(arr))
+
+
+def _read_exact(stream: BinaryIO, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = stream.read(n - len(out))
+        if not chunk:
+            raise EOFError("truncated checkpoint stream")
+        out += chunk
+    return out
+
+
+def load_pytree(stream: BinaryIO) -> Any:
+    magic = _read_exact(stream, len(MAGIC))
+    if magic != MAGIC:
+        raise ValueError(f"bad checkpoint magic {magic!r}")
+    (skel_len,) = struct.unpack("<I", _read_exact(stream, 4))
+    skeleton = pickle.loads(_read_exact(stream, skel_len))
+    (narrays,) = struct.unpack("<I", _read_exact(stream, 4))
+
+    placeholders: List[_ArrayPlaceholder] = [None] * narrays  # type: ignore[list-item]
+
+    def _collect(obj: Any) -> None:
+        if isinstance(obj, _ArrayPlaceholder):
+            placeholders[obj.index] = obj
+        elif isinstance(obj, dict):
+            for v in obj.values():
+                _collect(v)
+        elif isinstance(obj, (list, tuple)):
+            for v in obj:
+                _collect(v)
+
+    _collect(skeleton)
+
+    arrays: List[np.ndarray] = []
+    for i in range(narrays):
+        ph = placeholders[i]
+        assert ph is not None, f"missing placeholder for array {i}"
+        (nbytes,) = struct.unpack("<Q", _read_exact(stream, 8))
+        dtype = _resolve_dtype(ph.dtype)
+        arr = np.empty(ph.shape, dtype=dtype)
+        if nbytes != arr.nbytes:
+            raise ValueError(
+                f"array {i}: payload {nbytes} bytes != expected {arr.nbytes}"
+            )
+        view = as_byte_view(arr)
+        read_into = stream.readinto if hasattr(stream, "readinto") else None
+        off = 0
+        while off < nbytes:
+            if read_into is not None:
+                n = read_into(view[off:])
+                if not n:
+                    raise EOFError("truncated checkpoint stream")
+            else:
+                chunk = stream.read(min(1 << 20, nbytes - off))
+                if not chunk:
+                    raise EOFError("truncated checkpoint stream")
+                view[off : off + len(chunk)] = chunk
+                n = len(chunk)
+            off += n
+        arrays.append(arr)
+
+    return _restore_arrays(skeleton, arrays)
+
+
+def dumps_pytree(state: Any) -> bytes:
+    buf = io.BytesIO()
+    save_pytree(state, buf)
+    return buf.getvalue()
+
+
+def loads_pytree(data: bytes) -> Any:
+    return load_pytree(io.BytesIO(data))

@@ -1,0 +1,38 @@
+"""Checkpoint transport interface for live peer-to-peer healing.
+
+Mirror of the reference ABC (``torchft/checkpointing/transport.py:14-68``):
+a transport advertises ``metadata()`` (carried through the manager quorum so
+peers can find it), serves the current state dict to recovering destination
+ranks, and fetches a peer's state dict when this replica heals.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Dict, Generic, List, TypeVar
+
+T = TypeVar("T")
+
+
+class CheckpointTransport(ABC, Generic[T]):
+    @abstractmethod
+    def metadata(self) -> str:
+        """Opaque metadata handed to recovering peers (e.g. a URL)."""
+
+    @abstractmethod
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: T, timeout: float
+    ) -> None:
+        """Make ``state_dict`` available to ``dst_ranks`` for ``step``."""
+
+    def disallow_checkpoint(self) -> None:
+        """Called after the quorum; the staged checkpoint may be dropped."""
+
+    @abstractmethod
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: float
+    ) -> T:
+        """Fetch the checkpoint for ``step`` from the peer at ``metadata``."""
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Release resources (called from Manager.shutdown)."""

@@ -60,6 +60,12 @@ class ReduceOp(Enum):
     MIN = "min"
 
 
+def _bytes_view(arr: np.ndarray) -> memoryview:
+    """Writable raw-byte view of a contiguous array; extension dtypes like
+    bfloat16 reject memoryview.cast, so reinterpret through uint8 instead."""
+    return memoryview(arr.reshape(-1).view(np.uint8))
+
+
 def _reduce_into(op: ReduceOp, acc: np.ndarray, incoming: np.ndarray) -> None:
     if op in (ReduceOp.SUM, ReduceOp.AVG):
         np.add(acc, incoming, out=acc)
@@ -242,6 +248,42 @@ class _TcpMesh:
         if self._aborted.is_set():
             raise CommunicatorAborted("communicator aborted")
 
+    def recv_dynamic(self, src: int, tag: int, deadline: float) -> bytes:
+        """Receive one frame from ``src`` without knowing its size upfront —
+        the frame header carries nbytes, so this pairs with any plain send."""
+        sock = self.peers[src]
+
+        def _recv_some(view: memoryview) -> int:
+            while True:
+                self._check_abort()
+                if time.monotonic() > deadline:
+                    raise TimeoutError("recv_dynamic timed out")
+                readable, _, _ = select.select([sock], [], [], 0.1)
+                if not readable:
+                    continue
+                try:
+                    n = sock.recv_into(view)
+                except BlockingIOError:
+                    continue
+                if n == 0:
+                    raise CommunicatorError(f"connection to rank {src} closed")
+                return n
+
+        hdr = bytearray(_HDR.size)
+        off = 0
+        while off < len(hdr):
+            off += _recv_some(memoryview(hdr)[off:])
+        nbytes, rtag = _HDR.unpack(bytes(hdr))
+        if rtag != tag:
+            raise CommunicatorError(
+                f"tag mismatch from rank {src}: got {rtag}, want {tag}"
+            )
+        buf = bytearray(nbytes)
+        off = 0
+        while off < nbytes:
+            off += _recv_some(memoryview(buf)[off:])
+        return bytes(buf)
+
     def exchange(
         self,
         sends: List[Tuple[int, int, memoryview]],
@@ -393,24 +435,36 @@ class TCPCommunicator(Communicator):
         group_world_size: int = 1,
         global_ranks: Sequence[int] = (),
     ) -> None:
+        # Rendezvous can block up to timeout_s waiting for peers; it must
+        # happen OUTSIDE self._lock so timers/aborts stay responsive.
         with self._lock:
             self._teardown_locked(reason="superseded by reconfigure")
+            self._epoch += 1
+            epoch = self._epoch
             self._rank = rank
             self._world_size = world_size
             self._quorum_id = quorum_id
             self._errored = None
-            self._epoch += 1
-            if world_size > 1:
-                self._mesh = _TcpMesh(
-                    store_addr, rank, world_size, self._timeout_s
+            self._mesh = None
+
+        mesh: Optional[_TcpMesh] = None
+        if world_size > 1:
+            mesh = _TcpMesh(store_addr, rank, world_size, self._timeout_s)
+
+        with self._lock:
+            if self._epoch != epoch:
+                # superseded while we were rendezvousing
+                if mesh is not None:
+                    mesh.abort()
+                raise CommunicatorAborted(
+                    "configure superseded by a newer configure/abort"
                 )
-            else:
-                self._mesh = None
+            self._mesh = mesh
             self._ops = queue.Queue()
             self._op_thread = threading.Thread(
                 target=self._run_ops,
-                args=(self._ops, self._epoch),
-                name=f"tpuft_comm_ops_{self._epoch}",
+                args=(self._ops, epoch),
+                name=f"tpuft_comm_ops_{epoch}",
                 daemon=True,
             )
             self._op_thread.start()
@@ -442,10 +496,14 @@ class TCPCommunicator(Communicator):
     def abort(self, reason: str = "aborted") -> None:
         """Unblock in-flight collectives and poison until reconfigure."""
         with self._lock:
-            if self._errored is None:
-                self._errored = CommunicatorAborted(reason)
-            self._teardown_locked(reason=reason)
+            self._abort_locked(reason)
         logger.warning("communicator aborted: %s", reason)
+
+    def _abort_locked(self, reason: str) -> None:
+        if self._errored is None:
+            self._errored = CommunicatorAborted(reason)
+        self._teardown_locked(reason=reason)
+        self._epoch += 1  # invalidates in-flight configure/timers
 
     def errored(self) -> Optional[Exception]:
         return self._errored
@@ -465,11 +523,17 @@ class TCPCommunicator(Communicator):
     # -- op submission -------------------------------------------------------
 
     def _abort_if_epoch(self, epoch: int, reason: str) -> None:
-        # late timers from a superseded epoch must not abort the new mesh
-        with self._lock:
-            if self._epoch != epoch:
-                return
-        self.abort(reason)
+        # Check-and-abort atomically so a stale timer can never poison a
+        # newer epoch; runs on a spawned thread so the shared timer thread
+        # is never blocked on this lock.
+        def _do() -> None:
+            with self._lock:
+                if self._epoch != epoch:
+                    return
+                self._abort_locked(reason)
+            logger.warning("communicator aborted: %s", reason)
+
+        threading.Thread(target=_do, name="tpuft_comm_abort", daemon=True).start()
 
     def _run_ops(
         self,
@@ -579,39 +643,14 @@ class TCPCommunicator(Communicator):
 
         return self._submit(_make)
 
-    def recv_bytes(self, src: int, tag: int = 0, nbytes: Optional[int] = None) -> Work:
+    def recv_bytes(self, src: int, tag: int = 0) -> Work:
+        """Receive one frame from ``src``; the size rides in the frame header
+        so this pairs directly with :meth:`send_bytes` of any length."""
+
         def _make(ctx: "_CommCtx") -> Callable[[], object]:
             def _run() -> object:
                 mesh = ctx.require_peer(src)
-                deadline = ctx.deadline()
-                if nbytes is not None:
-                    buf = bytearray(nbytes)
-                    mesh.exchange([], [(src, tag, memoryview(buf))], deadline)
-                    return bytes(buf)
-                # length-prefixed variant: peer sends an 8-byte length first
-                lenbuf = bytearray(8)
-                mesh.exchange([], [(src, tag, memoryview(lenbuf))], deadline)
-                (n,) = struct.unpack("<Q", bytes(lenbuf))
-                buf = bytearray(n)
-                mesh.exchange([], [(src, tag + 1, memoryview(buf))], deadline)
-                return bytes(buf)
-
-            return _run
-
-        return self._submit(_make)
-
-    def send_bytes_framed(self, data: bytes, dst: int, tag: int = 0) -> Work:
-        """Length-prefixed pair for :meth:`recv_bytes` without ``nbytes``."""
-        header = struct.pack("<Q", len(data))
-        view = memoryview(data)
-
-        def _make(ctx: "_CommCtx") -> Callable[[], object]:
-            def _run() -> object:
-                mesh = ctx.require_peer(dst)
-                deadline = ctx.deadline()
-                mesh.exchange([(dst, tag, memoryview(header))], [], deadline)
-                mesh.exchange([(dst, tag + 1, view)], [], deadline)
-                return len(view)
+                return mesh.recv_dynamic(src, tag, ctx.deadline())
 
             return _run
 
@@ -661,32 +700,38 @@ def _allreduce_sync(
     out = [np.array(a, copy=True) for a in arrays]
     if ws > 1:
         assert ctx.mesh is not None
-        # flatten into one contiguous buffer: one ring instead of many
-        single_contig = len(out) == 1 and out[0].flags.c_contiguous
-        flat = (
-            out[0].reshape(-1)
-            if single_contig
-            else np.concatenate([a.reshape(-1) for a in out])
-        )
-        _ring_allreduce(ctx, flat, op)
-        if single_contig:
-            out[0] = flat.reshape(out[0].shape)
-        else:
+        # one flat ring per dtype — concatenating mixed dtypes would silently
+        # promote (f32+i64 → f64) and return wrong-dtype buffers
+        by_dtype: Dict[str, List[int]] = {}
+        for i, a in enumerate(out):
+            by_dtype.setdefault(a.dtype.name, []).append(i)
+        for ring_idx, idxs in enumerate(by_dtype.values()):
+            if len(idxs) == 1 and out[idxs[0]].flags.c_contiguous:
+                flat = out[idxs[0]].reshape(-1)
+                _ring_allreduce(ctx, flat, op, tag_base=ring_idx * 10_000)
+                out[idxs[0]] = flat.reshape(out[idxs[0]].shape)
+                continue
+            flat = np.concatenate([out[i].reshape(-1) for i in idxs])
+            _ring_allreduce(ctx, flat, op, tag_base=ring_idx * 10_000)
             offset = 0
-            for i, a in enumerate(out):
-                n = a.size
-                out[i] = flat[offset : offset + n].reshape(a.shape)
+            for i in idxs:
+                n = out[i].size
+                out[i] = flat[offset : offset + n].reshape(out[i].shape)
                 offset += n
     if op == ReduceOp.AVG:
         for a in out:
-            if np.issubdtype(a.dtype, np.inexact):
-                np.divide(a, ws, out=a)
-            else:
+            if np.issubdtype(a.dtype, np.integer):
                 a //= ws
+            else:
+                # bfloat16/fp8 are not np.inexact subdtypes; true-divide all
+                # non-integer dtypes in place
+                np.divide(a, ws, out=a)
     return out
 
 
-def _ring_allreduce(ctx: _CommCtx, flat: np.ndarray, op: ReduceOp) -> None:
+def _ring_allreduce(
+    ctx: _CommCtx, flat: np.ndarray, op: ReduceOp, tag_base: int = 0
+) -> None:
     """In-place bandwidth-optimal ring allreduce.
 
     Reduce-scatter then allgather, ws-1 steps each; every step exchanges one
@@ -717,8 +762,8 @@ def _ring_allreduce(ctx: _CommCtx, flat: np.ndarray, op: ReduceOp) -> None:
         send_chunk = chunk(send_idx)
         recv_buf = scratch[: chunk(recv_idx).size]
         mesh.exchange(
-            [(right, 1000 + step, memoryview(send_chunk).cast("B"))],
-            [(left, 1000 + step, memoryview(recv_buf).cast("B"))],
+            [(right, tag_base + 1000 + step, _bytes_view(send_chunk))],
+            [(left, tag_base + 1000 + step, _bytes_view(recv_buf))],
             deadline,
         )
         _reduce_into(op, chunk(recv_idx), recv_buf)
@@ -727,8 +772,8 @@ def _ring_allreduce(ctx: _CommCtx, flat: np.ndarray, op: ReduceOp) -> None:
         send_idx = (rank + 1 - step) % ws
         recv_idx = (rank - step) % ws
         mesh.exchange(
-            [(right, 2000 + step, memoryview(chunk(send_idx)).cast("B"))],
-            [(left, 2000 + step, memoryview(chunk(recv_idx)).cast("B"))],
+            [(right, tag_base + 2000 + step, _bytes_view(chunk(send_idx)))],
+            [(left, tag_base + 2000 + step, _bytes_view(chunk(recv_idx)))],
             deadline,
         )
 
@@ -743,13 +788,13 @@ def _broadcast_sync(ctx: _CommCtx, arrays: List[np.ndarray], root: int) -> List[
     deadline = ctx.deadline()
     if ctx.rank == root:
         for i, a in enumerate(out):
-            view = memoryview(a).cast("B")
+            view = _bytes_view(a)
             sends = [(p, 3000 + i, view) for p in mesh.peers]
             mesh.exchange(sends, [], deadline)
     else:
         for i, a in enumerate(out):
             mesh.exchange(
-                [], [(root, 3000 + i, memoryview(a).cast("B"))], deadline
+                [], [(root, 3000 + i, _bytes_view(a))], deadline
             )
     return out
 
@@ -803,7 +848,9 @@ class DummyCommunicator(Communicator):
 
 class FakeCommunicatorWrapper(Communicator):
     """Error-injection wrapper for tests (``process_group.py:1252-1317``):
-    ``report_future_error`` makes the next collective fail."""
+    ``report_future_error`` makes the next collective's *future* fail while
+    the underlying collective still runs, so peers are not wedged — matching
+    the reference semantics (``process_group.py:1290-1317``)."""
 
     def __init__(self, comm: Communicator) -> None:
         self._comm = comm
@@ -813,33 +860,35 @@ class FakeCommunicatorWrapper(Communicator):
     def report_future_error(self, err: Exception) -> None:
         self._next_error = err
 
-    def _maybe_fail(self) -> Optional[Work]:
+    def _wrap(self, work: Work) -> Work:
         if self._next_error is not None:
             err, self._next_error = self._next_error, None
             self._errored = err
-            fut: Future = Future()
-            fut.set_exception(err)
-            return Work(fut)
-        return None
+
+            def _fail(_value: object) -> object:
+                raise err
+
+            return work.then(_fail)
+        return work
 
     def configure(self, *args, **kwargs) -> None:  # type: ignore[override]
         self._errored = None
         self._comm.configure(*args, **kwargs)
 
     def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
-        return self._maybe_fail() or self._comm.allreduce(buffers, op)
+        return self._wrap(self._comm.allreduce(buffers, op))
 
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
-        return self._maybe_fail() or self._comm.broadcast(buffers, root)
+        return self._wrap(self._comm.broadcast(buffers, root))
 
     def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
-        return self._maybe_fail() or self._comm.send_bytes(data, dst, tag)
+        return self._wrap(self._comm.send_bytes(data, dst, tag))
 
     def recv_bytes(self, src: int, tag: int = 0) -> Work:
-        return self._maybe_fail() or self._comm.recv_bytes(src, tag)
+        return self._wrap(self._comm.recv_bytes(src, tag))
 
     def barrier(self) -> Work:
-        return self._maybe_fail() or self._comm.barrier()
+        return self._wrap(self._comm.barrier())
 
     def abort(self, reason: str = "aborted") -> None:
         self._comm.abort(reason)

@@ -1,0 +1,80 @@
+"""Fault-tolerant data sharding.
+
+Twin of the reference sampler (``torchft/data.py:24-77``): the dataset is
+sharded across ``num_replica_groups × num_workers_per_group`` shards and this
+worker reads shard ``global_rank = group_rank + num_workers * replica_rank``.
+Same documented-lossy semantics: when the replica count changes, workers keep
+their shard assignment from construction time; exactly-once delivery across
+failures is explicitly out of scope (steps, not samples, are the unit of
+fault tolerance).
+
+Index-based (grain-style) rather than iterator-based: ``__getitem__`` of any
+random-access dataset composes with it.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+class DistributedSampler:
+    def __init__(
+        self,
+        dataset_len: int,
+        replica_rank: int,
+        num_replica_groups: int,
+        group_rank: int = 0,
+        num_workers_per_group: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ) -> None:
+        self._dataset_len = dataset_len
+        self._num_shards = num_replica_groups * num_workers_per_group
+        self._global_rank = group_rank + num_workers_per_group * replica_rank
+        self._shuffle = shuffle
+        self._seed = seed
+        self._drop_last = drop_last
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    @property
+    def num_samples(self) -> int:
+        if self._drop_last:
+            return self._dataset_len // self._num_shards
+        return -(-self._dataset_len // self._num_shards)
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def indices(self) -> List[int]:
+        order = np.arange(self._dataset_len)
+        if self._shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            rng.shuffle(order)
+        if not self._drop_last:
+            pad = (-len(order)) % self._num_shards
+            if pad:
+                order = np.concatenate([order, order[:pad]])
+        usable = (len(order) // self._num_shards) * self._num_shards
+        return list(order[self._global_rank : usable : self._num_shards])
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices())
+
+
+def batch_indices(
+    sampler: DistributedSampler, batch_size: int, drop_last: bool = True
+) -> Iterator[List[int]]:
+    batch: List[int] = []
+    for idx in sampler:
+        batch.append(idx)
+        if len(batch) == batch_size:
+            yield batch
+            batch = []
+    if batch and not drop_last:
+        yield batch

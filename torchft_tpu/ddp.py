@@ -1,0 +1,101 @@
+"""Fault-tolerant data parallelism over the replica dimension.
+
+The reference hooks torch DDP's bucket reducer into ``manager.allreduce``
+(``torchft/ddp.py:31-78``).  JAX has no module/buckets: gradients are a
+pytree produced by ``jax.grad`` inside a compiled step.  The replica-dim
+average runs host-side — leaves are fetched to host, flattened into one
+contiguous buffer per dtype (the bucketization DDP gets from its reducer),
+ring-allreduced over DCN/TCP, and pushed back to device with the original
+shardings.  Compiled programs never see the replica count (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from torchft_tpu.manager import Manager
+from torchft_tpu.work import Work
+
+
+def _to_host(leaf: Any) -> np.ndarray:
+    # np.asarray on a jax.Array device_gets; numpy passes through
+    return np.asarray(leaf)
+
+
+def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False) -> Work:
+    """Average a pytree of gradients across participating replicas.
+
+    Returns a Work whose value is the averaged pytree with original leaf
+    types restored (jax leaves come back as device arrays with their
+    original sharding).  Error swallowing and participation zeroing happen
+    inside ``manager.allreduce``.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    original = list(leaves)
+
+    # bucket by dtype so each dtype rides one ring (DDP-style flat buckets)
+    host: List[np.ndarray] = [_to_host(leaf) for leaf in leaves]
+    order: Dict[str, List[int]] = {}
+    for i, arr in enumerate(host):
+        order.setdefault(arr.dtype.name, []).append(i)
+
+    buckets: List[np.ndarray] = []
+    bucket_layout: List[List[Tuple[int, int, int, tuple]]] = []
+    for dtype_name, idxs in order.items():
+        total = sum(host[i].size for i in idxs)
+        flat = np.empty(total, dtype=host[idxs[0]].dtype)
+        layout = []
+        off = 0
+        for i in idxs:
+            n = host[i].size
+            flat[off : off + n] = host[i].reshape(-1)
+            layout.append((i, off, n, host[i].shape))
+            off += n
+        buckets.append(flat)
+        bucket_layout.append(layout)
+
+    work = manager.allreduce(buckets, should_quantize=should_quantize)
+
+    def _unbucket(reduced: Any) -> Any:
+        arrays: List[np.ndarray] = (
+            reduced if isinstance(reduced, list) else [reduced]
+        )
+        out = list(original)
+        for flat, layout in zip(arrays, bucket_layout):
+            for i, off, n, shape in layout:
+                host_val = flat[off : off + n].reshape(shape)
+                leaf = original[i]
+                if isinstance(leaf, jax.Array):
+                    out[i] = jax.device_put(
+                        host_val,
+                        leaf.sharding if hasattr(leaf, "sharding") else None,
+                    )
+                else:
+                    out[i] = host_val
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return work.then(_unbucket)
+
+
+def ft_allreduce(manager: Manager, tree: Any, should_quantize: bool = False) -> Any:
+    """Synchronous convenience: averaged pytree, or the input unchanged if
+    this step already errored (the vote will discard it)."""
+    return allreduce_pytree(manager, tree, should_quantize).wait()
+
+
+class DistributedDataParallel:
+    """Object-style facade matching the reference class name
+    (``torchft/ddp.py:31-78``): holds the manager and averages gradient
+    pytrees produced by a compiled step."""
+
+    def __init__(self, manager: Manager) -> None:
+        self.manager = manager
+
+    def average_gradients(self, grads: Any, should_quantize: bool = False) -> Any:
+        return ft_allreduce(self.manager, grads, should_quantize)
+
+    def average_gradients_async(self, grads: Any, should_quantize: bool = False) -> Work:
+        return allreduce_pytree(self.manager, grads, should_quantize)

@@ -189,6 +189,9 @@ class LighthouseServer:
         self._generation = 0  # bumped on every broadcast quorum
         self._change_reason: Optional[str] = None
         self._shutdown = False
+        # parked quorum waiters (token → member), re-registered atomically
+        # when a quorum excludes them — see _tick_locked
+        self._parked: Dict[object, QuorumMember] = {}
 
         host, port = bind.rsplit(":", 1)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -272,6 +275,15 @@ class LighthouseServer:
         )
         state.prev_quorum = quorum
         state.participants.clear()
+        # Atomically re-register parked waiters the new quorum excluded.
+        # The reference re-registers from the waiter's own loop
+        # (src/lighthouse.rs:534-543), which can livelock when fast-stepping
+        # members re-request (and proactively tick) before an excluded
+        # waiter's thread wakes; doing it here closes that race.
+        included = {m.replica_id for m in quorum.participants}
+        for member in self._parked.values():
+            if member.replica_id not in included:
+                self._register(member)
         self._generation += 1
         self._lock.notify_all()
 
@@ -347,40 +359,54 @@ class LighthouseServer:
         deadline = time.monotonic() + timeout_ms / 1000.0
         logger.info("Received quorum request for replica %s", requester.replica_id)
 
+        token = object()
+        failure: Optional[Tuple[ErrCode, str]] = None
         with self._lock:
             self._register(requester)
+            self._parked[token] = requester
             gen = self._generation
-            self._tick_locked()  # proactive tick
-            while True:
-                if self._generation > gen:
-                    gen = self._generation
-                    quorum = self._state.prev_quorum
-                    assert quorum is not None
-                    if any(
-                        p.replica_id == requester.replica_id
-                        for p in quorum.participants
-                    ):
+            try:
+                self._tick_locked()  # proactive tick
+                while True:
+                    if self._generation > gen:
+                        gen = self._generation
+                        quorum = self._state.prev_quorum
+                        assert quorum is not None
+                        if any(
+                            p.replica_id == requester.replica_id
+                            for p in quorum.participants
+                        ):
+                            break
+                        # Quorum formed without us; _tick_locked already
+                        # re-registered us atomically — just keep waiting.
+                        logger.info(
+                            "Replica %s not in quorum, retrying",
+                            requester.replica_id,
+                        )
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._shutdown:
+                        failure = (
+                            ErrCode.SHUTDOWN if self._shutdown else ErrCode.TIMEOUT,
+                            f"quorum request for {requester.replica_id!r} "
+                            f"{'aborted by shutdown' if self._shutdown else 'timed out'}",
+                        )
                         break
-                    # Quorum formed without us (e.g. we registered right
-                    # after a round closed): re-register and keep waiting.
-                    logger.info(
-                        "Replica %s not in quorum, retrying", requester.replica_id
-                    )
-                    self._register(requester)
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 or self._shutdown:
-                    send_error(
-                        conn,
-                        ErrCode.SHUTDOWN if self._shutdown else ErrCode.TIMEOUT,
-                        f"quorum request for {requester.replica_id!r} "
-                        f"{'aborted by shutdown' if self._shutdown else 'timed out'}",
-                    )
-                    return
-                self._lock.wait(min(remaining, 0.1))
+                    self._lock.wait(min(remaining, 0.1))
+            finally:
+                del self._parked[token]
 
-        w = Writer()
-        quorum.encode(w)
-        send_frame(conn, MsgType.LH_QUORUM_RESP, w.payload())
+        # socket IO strictly outside the server lock: one dead/slow client's
+        # full TCP buffer must never wedge the lighthouse
+        conn.settimeout(30.0)
+        try:
+            if failure is not None:
+                send_error(conn, failure[0], failure[1])
+                return
+            w = Writer()
+            quorum.encode(w)
+            send_frame(conn, MsgType.LH_QUORUM_RESP, w.payload())
+        finally:
+            conn.settimeout(None)
 
     # -- status / dashboard -------------------------------------------------
 

@@ -1,0 +1,726 @@
+"""Manager: the per-replica fault-tolerance state machine.
+
+Behavioral twin of the reference Manager (``torchft/manager.py``), driving
+the per-step protocol from an otherwise ordinary train loop:
+
+- ``start_quorum()`` — compute a quorum (usually asynchronously, overlapped
+  with the forward pass), reconfigure the communicator when membership
+  changed, send live weights to recovering peers, and stage a healing
+  checkpoint when this replica is behind (``manager.py:560-813``).
+- ``allreduce()`` — average gradients across participating replicas with
+  error swallowing and zero-contribution for non-participants
+  (``manager.py:410-493``).
+- ``should_commit()`` — fence recovery and collectives, pick up async
+  errors, vote; commit advances the step, failure discards it
+  (``manager.py:855-943``).
+
+TPU-first notes: gradients arrive as numpy views of (shards of) jax arrays
+— the replica dimension runs host-side over DCN so the compiled XLA step
+never sees the replica count; the gradient divisor ``num_participants()`` is
+a runtime scalar.  There are no user streams: XLA dispatch is async on its
+own, so the reference's stream/event choreography collapses to thread joins
+(the ``_quorum_future``) and a plain recovery event.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import socket
+import threading
+import uuid
+from enum import Enum
+from typing import Callable, Dict, List, Optional, TypeVar, Union, cast
+
+import numpy as np
+
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.communicator import Communicator, ReduceOp
+from torchft_tpu.manager_server import ManagerClient, ManagerServer
+from torchft_tpu.store import StoreClient, StoreServer
+from torchft_tpu.work import DummyWork, Event, Work
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+MANAGER_ADDR_KEY = "manager_addr"
+REPLICA_ID_KEY = "replica_id"
+
+# Env knobs (same names as the reference, ``manager.py:74-109``)
+MANAGER_PORT_ENV = "TORCHFT_MANAGER_PORT"
+LIGHTHOUSE_ENV = "TORCHFT_LIGHTHOUSE"
+TIMEOUT_SEC_ENV = "TORCHFT_TIMEOUT_SEC"
+QUORUM_TIMEOUT_SEC_ENV = "TORCHFT_QUORUM_TIMEOUT_SEC"
+CONNECT_TIMEOUT_SEC_ENV = "TORCHFT_CONNECT_TIMEOUT_SEC"
+QUORUM_RETRIES_ENV = "TORCHFT_QUORUM_RETRIES"
+
+
+def _env_timeout(env: str, default_s: float) -> float:
+    value = os.environ.get(env)
+    return float(value) if value is not None else default_s
+
+
+def extract_trailing_digits(s: str) -> int:
+    """Trailing integer of a replica-group name (``manager.py:112-121``),
+    used to map replica ids like ``train_ddp_7`` to global rank math."""
+    i = len(s) - 1
+    while i >= 0 and s[i].isdigit():
+        i -= 1
+    return int(s[i + 1 :]) if i < len(s) - 1 else 0
+
+
+class WorldSizeMode(Enum):
+    """Numerics when more than ``min_replica_size`` replicas are healthy
+    (``manager.py:123-139``): DYNAMIC grows the divisor with membership;
+    FIXED_WITH_SPARES keeps exactly ``min_replica_size`` participants and
+    spares contribute zero gradients."""
+
+    DYNAMIC = 0
+    FIXED_WITH_SPARES = 1
+
+
+class ExceptionWithTraceback(Exception):
+    def __init__(self, e: Exception) -> None:
+        import traceback
+
+        self.original_exception = e
+        self.stack_trace: str = traceback.format_exc()
+        super().__init__(f"{e}\n{self.stack_trace}")
+
+
+class Manager:
+    """Fault-tolerant training loop manager (``torchft/manager.py:148+``)."""
+
+    def __init__(
+        self,
+        comm: Communicator,
+        load_state_dict: Optional[Callable[[T], None]],
+        state_dict: Optional[Callable[[], T]],
+        min_replica_size: int,
+        use_async_quorum: bool = True,
+        timeout: float = 60.0,
+        quorum_timeout: float = 60.0,
+        connect_timeout: float = 60.0,
+        rank: Optional[int] = None,
+        world_size: Optional[int] = None,
+        world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+        store_addr: Optional[str] = None,
+        store_port: Optional[int] = None,
+        lighthouse_addr: Optional[str] = None,
+        replica_id: Optional[str] = None,
+        port: Optional[int] = None,
+        hostname: Optional[str] = None,
+        heartbeat_interval: float = 0.1,
+        checkpoint_transport: Optional[CheckpointTransport] = None,
+        init_sync: bool = True,
+        max_retries: Optional[int] = None,
+        quorum_retries: int = 0,
+        _manager_client: Optional[ManagerClient] = None,
+        _peer_client_factory: Optional[Callable[[str], ManagerClient]] = None,
+    ) -> None:
+        self.quorum_logger = logging.getLogger("torchft_quorums")
+        self.commits_logger = logging.getLogger("torchft_commits")
+        self.errors_logger = logging.getLogger("torchft_errors")
+
+        self._load_state_dict_fns: Dict[str, Callable[[object], None]] = {}
+        self._user_state_dicts: Dict[str, Callable[[], object]] = {}
+        if load_state_dict and state_dict:
+            self.register_state_dict_fn("default", load_state_dict, state_dict)
+
+        self._comm = comm
+        self._min_replica_size = min_replica_size
+        self._use_async_quorum = use_async_quorum
+        self._init_sync = init_sync
+        self._max_retries = max_retries
+        self._replica_world_size_mode = world_size_mode
+
+        self._timeout = _env_timeout(TIMEOUT_SEC_ENV, timeout)
+        self._quorum_timeout = _env_timeout(QUORUM_TIMEOUT_SEC_ENV, quorum_timeout)
+        self._connect_timeout = _env_timeout(CONNECT_TIMEOUT_SEC_ENV, connect_timeout)
+        quorum_retries = int(os.environ.get(QUORUM_RETRIES_ENV, quorum_retries))
+
+        self._group_rank: int = rank if rank is not None else int(os.environ.get("RANK", 0))
+        self._group_world_size: int = (
+            world_size
+            if world_size is not None
+            else int(os.environ.get("WORLD_SIZE", 1))
+        )
+        hostname = hostname or socket.gethostname()
+
+        # state dict guard: reads (checkpoint serving) vs writes (train loop)
+        self._state_dict_lock = RWLock(timeout=self._timeout)
+
+        self._pending_state_dict: Optional[Dict[str, object]] = None
+        self._healing = False
+        self._errored: Optional[ExceptionWithTraceback] = None
+        self._recovery_event: Optional[Event] = None
+
+        self._step = 0
+        self._batches_committed = 0
+        self._commit_failures = 0
+        self._quorum_id = -1
+        self._quorum_future: Optional[concurrent.futures.Future] = None
+        self._participating_replica_rank: Optional[int] = None
+        self._participating_replica_world_size: int = 0
+
+        # one worker: quorum computation overlaps the forward pass
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpuft_async_quorum"
+        )
+
+        if checkpoint_transport is None:
+            from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+            checkpoint_transport = HTTPTransport(timeout=self._timeout)
+        self._checkpoint_transport: CheckpointTransport = checkpoint_transport
+
+        self._own_store: Optional[StoreServer] = None
+        self._manager_server: Optional[ManagerServer] = None
+        self._peer_client_factory: Callable[[str], ManagerClient] = (
+            _peer_client_factory
+            or (lambda addr: ManagerClient(addr, connect_timeout=self._connect_timeout))
+        )
+
+        if _manager_client is not None:
+            # test hook: fully mocked control plane (``manager_test.py:41-82``)
+            self._client = _manager_client
+            self._replica_id = replica_id or "testing"
+            self._store: Optional[StoreClient] = None
+            return
+
+        # -- store bootstrap ------------------------------------------------
+        if store_addr is None:
+            store_addr = os.environ.get("MASTER_ADDR")
+            store_port = store_port or int(os.environ.get("MASTER_PORT", 0) or 0)
+        if store_addr is None:
+            if self._group_world_size != 1:
+                raise ValueError(
+                    "store_addr (or MASTER_ADDR) is required for multi-rank "
+                    "replica groups"
+                )
+            # single-process replica group: own the store
+            self._own_store = StoreServer("0.0.0.0:0")
+            store_addr, store_port = "127.0.0.1", self._own_store.port
+        self._store = StoreClient(
+            f"{store_addr}:{store_port}", timeout=self._connect_timeout
+        )
+        # the store address peers will use for communicator rendezvous
+        advertised_store = f"{hostname}:{store_port}"
+
+        if self._group_rank == 0:
+            if replica_id is None:
+                replica_id = ""
+            # keep the human prefix, add entropy so restarts are distinct
+            # (``manager.py:316-320``)
+            new_uuid = str(uuid.uuid4())
+            replica_id = (
+                new_uuid if replica_id in (None, "") else f"{replica_id}:{new_uuid}"
+            )
+            if lighthouse_addr is None:
+                lighthouse_addr = os.environ[LIGHTHOUSE_ENV]
+            bind_port = port or int(os.environ.get(MANAGER_PORT_ENV, 0))
+            self._manager_server = ManagerServer(
+                replica_id=replica_id,
+                lighthouse_addr=lighthouse_addr,
+                hostname=hostname,
+                bind=f"0.0.0.0:{bind_port}",
+                store_addr=advertised_store,
+                world_size=self._group_world_size,
+                heartbeat_interval=heartbeat_interval,
+                connect_timeout=self._connect_timeout,
+                quorum_retries=quorum_retries,
+            )
+            self._store.set(MANAGER_ADDR_KEY, self._manager_server.address().encode())
+            self._store.set(REPLICA_ID_KEY, replica_id.encode())
+
+        addr = self._store.get(MANAGER_ADDR_KEY, timeout=self._connect_timeout).decode()
+        self._replica_id = self._store.get(
+            REPLICA_ID_KEY, timeout=self._connect_timeout
+        ).decode()
+        self._client = ManagerClient(addr, connect_timeout=self._connect_timeout)
+        self._logger = _ManagerLogger(self, self._replica_id, self._group_rank)
+
+    # ------------------------------------------------------------------
+    # state dict registry
+    # ------------------------------------------------------------------
+
+    def register_state_dict_fn(
+        self,
+        key: str,
+        load_state_dict: Callable[[T], None],
+        state_dict: Callable[[], T],
+    ) -> None:
+        """Register one named (load, save) pair; all registered entries ride
+        in the healing checkpoint (``manager.py:380-391``)."""
+        self._load_state_dict_fns[key] = cast(Callable[[object], None], load_state_dict)
+        self._user_state_dicts[key] = state_dict
+
+    def _manager_state_dict(self) -> Dict[str, object]:
+        with self._state_dict_lock.r_lock():
+            return {
+                "user": {key: fn() for key, fn in self._user_state_dicts.items()},
+                "torchft": self.state_dict(),
+            }
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"step": self._step, "batches_committed": self._batches_committed}
+
+    def load_state_dict(self, state_dict: Dict[str, int]) -> None:
+        self._step = state_dict["step"]
+        self._batches_committed = state_dict["batches_committed"]
+
+    # ------------------------------------------------------------------
+    # error funnel
+    # ------------------------------------------------------------------
+
+    def errored(self) -> Optional[ExceptionWithTraceback]:
+        return self._errored
+
+    def report_error(self, e: Exception) -> None:
+        """Record an error for this step; the step will be voted down at
+        commit instead of raising into the train loop
+        (``manager.py:495-520``)."""
+        wrapped = (
+            e
+            if isinstance(e, ExceptionWithTraceback)
+            else ExceptionWithTraceback(e)
+        )
+        self._errored = wrapped
+        self.errors_logger.info(
+            "",
+            extra={
+                "job_id": os.environ.get("JOB_ID", "unknown"),
+                "replica_id": self._replica_id,
+                "rank": self._group_rank,
+                "quorum_id": self._quorum_id,
+                "step": self._step,
+                "error": str(e),
+            },
+        )
+
+    def wrap_work(self, work: Work, default: object) -> Work:
+        """Swallow errors from async work: on failure, record and substitute
+        ``default`` (``manager.py:522-558``)."""
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _chain(f: concurrent.futures.Future) -> None:
+            err = f.exception()
+            if err is not None:
+                if isinstance(err, Exception):
+                    self.report_error(err)
+                fut.set_result(default)
+            else:
+                fut.set_result(f.result())
+
+        work.future().add_done_callback(_chain)
+        return Work(fut)
+
+    # ------------------------------------------------------------------
+    # quorum
+    # ------------------------------------------------------------------
+
+    def start_quorum(
+        self,
+        allow_heal: bool = True,
+        shrink_only: bool = False,
+        timeout: Optional[float] = None,
+    ) -> None:
+        """Compute a new quorum and ready the manager for a new step
+        (``manager.py:560-615``)."""
+        if self._quorum_future is not None:
+            self._quorum_future.result()
+
+        self._errored = None
+        self._healing = False
+
+        self._quorum_future = self._executor.submit(
+            self._async_quorum,
+            allow_heal=allow_heal,
+            shrink_only=shrink_only,
+            quorum_timeout=timeout or self._quorum_timeout,
+        )
+        if not self._use_async_quorum:
+            self.wait_quorum()
+            if self._healing:
+                # heal eagerly so the forward pass runs on good state
+                self._apply_pending_state_dict()
+                self._healing = False
+
+    def wait_quorum(self) -> None:
+        """Block until the pending quorum completes; the communicator is in a
+        healthy (re)configured state afterwards (``manager.py:617-627``)."""
+        assert self._quorum_future is not None, (
+            "must call start_quorum before wait_quorum"
+        )
+        self._quorum_future.result()
+
+    def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
+    ) -> None:
+        quorum = self._client._quorum(
+            group_rank=self._group_rank,
+            step=self._step,
+            checkpoint_metadata=self._checkpoint_transport.metadata(),
+            shrink_only=shrink_only,
+            timeout=quorum_timeout,
+            init_sync=self._init_sync,
+            commit_failures=self._commit_failures,
+        )
+
+        quorum_id = quorum.quorum_id
+        replica_rank = quorum.replica_rank
+        replica_world_size = quorum.replica_world_size
+        heal = quorum.heal
+        max_step = quorum.max_step
+
+        # ``ranks_in_quorum``: global ranks across the whole job
+        # (``manager.py:668-672``)
+        ranks_in_quorum = [
+            extract_trailing_digits(rid.split(":")[0]) * self._group_world_size
+            + self._group_rank
+            for rid in quorum.replica_ids
+        ]
+
+        # async quorum → healers are excluded (max-step set); sync quorum →
+        # everyone counts because heal completes before the step
+        self._participating_replica_rank, self._participating_replica_world_size = (
+            (quorum.max_replica_rank, quorum.max_world_size)
+            if self._use_async_quorum or not allow_heal
+            else (replica_rank, replica_world_size)
+        )
+
+        if self._replica_world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+            self._participating_replica_world_size = min(
+                self._participating_replica_world_size, self._min_replica_size
+            )
+            if (
+                self._participating_replica_rank is not None
+                and self._participating_replica_rank >= self._min_replica_size
+            ):
+                self._participating_replica_rank = None
+
+        if quorum_id != self._quorum_id:
+            self.quorum_logger.info(
+                "",
+                extra={
+                    "job_id": os.environ.get("JOB_ID", "unknown"),
+                    "replica_id": self._replica_id,
+                    "rank": self._group_rank,
+                    "quorum_id": quorum_id,
+                    "step": max_step,
+                },
+            )
+            store_prefixed_addr = (
+                f"{quorum.store_address}/torchft/{quorum_id}/{self._group_rank}"
+            )
+            self._logger.info(
+                f"reconfiguring for quorum_id={quorum_id} store={store_prefixed_addr}"
+            )
+            try:
+                self._quorum_id = quorum_id
+                self._comm.configure(
+                    store_prefixed_addr,
+                    self._replica_id if self._replica_id is not None else "0",
+                    replica_rank,
+                    replica_world_size,
+                    quorum_id=quorum_id,
+                    group_rank=self._group_rank,
+                    group_world_size=self._group_world_size,
+                    global_ranks=ranks_in_quorum,
+                )
+            except Exception as e:  # noqa: BLE001
+                self._logger.exception(f"got exception in comm configure: {e}")
+                self.report_error(e)
+                return
+
+        if allow_heal:
+            # The reference runs recovery on a dedicated CUDA stream
+            # (``manager.py:746-813``); here the quorum thread *is* the
+            # recovery lane and the event fences should_commit.
+            recovery_event = Event()
+            try:
+                if quorum.recover_dst_replica_ranks:
+                    self._logger.info(
+                        f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
+                    )
+                    self._checkpoint_transport.send_checkpoint(
+                        dst_ranks=quorum.recover_dst_replica_ranks,
+                        step=max_step,
+                        state_dict=self._manager_state_dict(),
+                        timeout=self._timeout,
+                    )
+
+                if heal:
+                    self._healing = True
+                    self._logger.info(
+                        "healing required, fetching checkpoint metadata from "
+                        f"{quorum.recover_src_manager_address} max_step={max_step}"
+                    )
+                    primary_client = self._peer_client_factory(
+                        quorum.recover_src_manager_address
+                    )
+                    checkpoint_metadata = primary_client._checkpoint_metadata(
+                        self._group_rank, timeout=self._timeout
+                    )
+                    primary_client.close()
+                    recover_src_replica_rank = quorum.recover_src_replica_rank
+                    assert recover_src_replica_rank is not None, (
+                        "must have a recover rank when healing"
+                    )
+                    self._logger.info(
+                        f"fetching checkpoint from {recover_src_replica_rank=} "
+                        f"with {checkpoint_metadata=}"
+                    )
+                    # applied on the main thread at should_commit when safe
+                    self._pending_state_dict = (
+                        self._checkpoint_transport.recv_checkpoint(
+                            src_rank=recover_src_replica_rank,
+                            metadata=checkpoint_metadata,
+                            step=max_step,
+                            timeout=self._timeout,
+                        )
+                    )
+                    self.load_state_dict(
+                        cast(Dict[str, int], self._pending_state_dict["torchft"])
+                    )
+                    self._step = max_step
+            except Exception as e:  # noqa: BLE001
+                self._logger.exception(f"got exception in recovery: {e}")
+                self.report_error(e)
+            recovery_event.record()
+            self._recovery_event = recovery_event
+
+    def _apply_pending_state_dict(self) -> None:
+        assert self._healing, "must be in healing state"
+        assert self._quorum_future is not None, "must call step before should_commit"
+        self._quorum_future.result()
+
+        pending_state_dict = self._pending_state_dict
+        if pending_state_dict is None:
+            assert self.errored(), "checkpoint was not staged and no error occurred"
+            return
+        self._logger.info("applying pending state dict")
+        assert self._load_state_dict_fns, "user load_state_dict is not initialized"
+        pending_user = cast(Dict[str, object], pending_state_dict["user"])
+        with self._state_dict_lock.w_lock():
+            for key, load_fn in self._load_state_dict_fns.items():
+                load_fn(pending_user[key])
+            self._pending_state_dict = None
+        self._logger.info("Loaded state dict.")
+
+    # ------------------------------------------------------------------
+    # gradient averaging
+    # ------------------------------------------------------------------
+
+    def allreduce(
+        self,
+        data: Union[np.ndarray, List[np.ndarray]],
+        should_quantize: bool = False,
+    ) -> Work:
+        """Fault-tolerant AVG allreduce of gradients across the participating
+        replicas (``manager.py:410-493``).
+
+        Returns a Work whose value is the averaged array(s).  If an error was
+        already recorded this step the input is returned unchanged; if this
+        replica is not participating (healing/spare) its contribution is
+        zeroed and the result is still divided by ``num_participants()``.
+        """
+        if self.errored():
+            return DummyWork(data)
+
+        self.wait_quorum()
+        num_participants = self.num_participants()
+
+        if not self.is_participating():
+            if isinstance(data, np.ndarray):
+                data.fill(0)
+            else:
+                for a in data:
+                    a.fill(0)
+
+        try:
+            if should_quantize:
+                from torchft_tpu.collectives import allreduce_quantized
+
+                work = allreduce_quantized(self._comm, data)
+            else:
+                work = self._comm.allreduce(data, ReduceOp.SUM)
+
+            # AVG = SUM / runtime participant count — replica count is never
+            # baked into compiled programs (SURVEY.md §7 hard part 1)
+            def _normalize(value: object) -> object:
+                if isinstance(value, np.ndarray):
+                    return _div(value, num_participants)
+                return [_div(a, num_participants) for a in cast(list, value)]
+
+            return self.wrap_work(work.then(_normalize), data)
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"got exception in all reduce -- skipping remaining: {e}")
+            self.report_error(e)
+            return DummyWork(data)
+
+    # ------------------------------------------------------------------
+    # commit
+    # ------------------------------------------------------------------
+
+    def should_commit(self, timeout: Optional[float] = None) -> bool:
+        """Vote on committing this step (``manager.py:855-943``)."""
+        # fence recovery before voting
+        if self._recovery_event is not None:
+            self._recovery_event.synchronize(timeout=self._timeout)
+            self._recovery_event = None
+
+        if (err := self._comm.errored()) is not None:
+            self.report_error(err)
+
+        if self._healing:
+            self._apply_pending_state_dict()
+
+        enough_replicas = self.num_participants() >= self._min_replica_size
+        local_should_commit = enough_replicas and self._errored is None
+        should_commit = self._client.should_commit(
+            self._group_rank,
+            self._step,
+            local_should_commit,
+            timeout=timeout or self._timeout,
+        )
+        self._logger.info(
+            f"should_commit={should_commit} enough_replicas={enough_replicas}, "
+            f"errored={self._errored}"
+        )
+
+        self.commits_logger.info(
+            "",
+            extra={
+                "job_id": os.environ.get("JOB_ID", "unknown"),
+                "replica_id": self._replica_id,
+                "rank": self._group_rank,
+                "quorum_id": self._quorum_id,
+                "step": self._step,
+                "commit_result": should_commit,
+            },
+        )
+
+        self._checkpoint_transport.disallow_checkpoint()
+
+        if should_commit:
+            self._step += 1
+            self._batches_committed += self.num_participants()
+            self._commit_failures = 0
+        else:
+            self._commit_failures += 1
+            if (
+                self._max_retries is not None
+                and self._commit_failures > self._max_retries
+            ):
+                msg = (
+                    f"should_commit failed {self._commit_failures} times "
+                    f"consecutively, exceeding max_retries={self._max_retries}"
+                )
+                self._logger.exception(msg)
+                raise RuntimeError(msg)
+        return should_commit
+
+    # ------------------------------------------------------------------
+    # participation facts
+    # ------------------------------------------------------------------
+
+    def is_participating(self) -> bool:
+        """False while healing (async quorum) or parked as a spare
+        (``manager.py:1003-1020``)."""
+        if self._participating_replica_rank is None:
+            return False
+        if self._healing:
+            assert self._use_async_quorum
+            return False
+        return True
+
+    def num_participants(self) -> int:
+        assert self._participating_replica_world_size >= 0, "internal error"
+        return self._participating_replica_world_size
+
+    def participating_rank(self) -> Optional[int]:
+        assert self._quorum_future is not None, "must call start_quorum before"
+        self._quorum_future.result()
+        return self._participating_replica_rank
+
+    def current_step(self) -> int:
+        """Current step count; incremented only on committed steps
+        (``manager.py:1030-1040``)."""
+        return self._step
+
+    def batches_committed(self) -> int:
+        return self._batches_committed
+
+    @property
+    def replica_id(self) -> str:
+        return self._replica_id
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._checkpoint_transport.shutdown(wait=False)
+        if self._quorum_future is not None:
+            try:
+                self._quorum_future.result(timeout=1.0)
+            except Exception:  # noqa: BLE001
+                pass
+        self._executor.shutdown(wait=False)
+        if self._manager_server is not None:
+            self._manager_server.shutdown()
+        if self._store is not None:
+            self._store.close()
+        if self._own_store is not None:
+            self._own_store.shutdown()
+        self._comm.shutdown()
+
+    # test-friendly logger attribute (mocked-client path sets it lazily)
+    @property
+    def _logger(self) -> "_ManagerLogger":
+        if not hasattr(self, "_logger_obj"):
+            self._logger_obj = _ManagerLogger(
+                self, getattr(self, "_replica_id", "?"), self._group_rank
+            )
+        return self._logger_obj
+
+    @_logger.setter
+    def _logger(self, value: "_ManagerLogger") -> None:
+        self._logger_obj = value
+
+
+def _div(a: np.ndarray, n: int) -> np.ndarray:
+    # integer grads floor-divide; everything else (incl. extension float
+    # dtypes like bfloat16, which are NOT np.inexact subdtypes) true-divides
+    if np.issubdtype(a.dtype, np.integer):
+        return a // n
+    np.divide(a, n, out=a)
+    return a
+
+
+class _ManagerLogger:
+    """Prefixes ``[replica/rank - step N]`` (``manager.py:1056-1073``)."""
+
+    def __init__(self, manager: Manager, replica_id: str, group_rank: int) -> None:
+        self._logger = logging.getLogger(__name__)
+        self._replica_id = replica_id
+        self._group_rank = group_rank
+        self._manager = manager
+
+    def _prefix(self) -> str:
+        return (
+            f"[{self._replica_id}/{self._group_rank} - "
+            f"step {self._manager.current_step()}]"
+        )
+
+    def info(self, msg: str) -> None:
+        self._logger.info(f"{self._prefix()} {msg}")
+
+    def warn(self, msg: str) -> None:
+        self._logger.warning(f"{self._prefix()} {msg}")
+
+    def exception(self, msg: str) -> None:
+        self._logger.exception(f"{self._prefix()} {msg}")

@@ -332,39 +332,49 @@ class ManagerServer:
                     daemon=True,
                 ).start()
 
+            failure: Optional[Tuple[ErrCode, str]] = None
             while self._quorum_gen == gen:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or self._shutdown:
-                    send_error(
-                        conn,
+                    failure = (
                         ErrCode.SHUTDOWN if self._shutdown else ErrCode.TIMEOUT,
                         f"manager quorum for group_rank {group_rank} "
                         f"{'aborted by shutdown' if self._shutdown else 'timed out'}",
                     )
-                    return
+                    break
                 self._lock.wait(min(remaining, 0.1))
             quorum = self._latest
             quorum_err = self._latest_err
 
-        if quorum is None:
-            send_error(conn, ErrCode.UNKNOWN, quorum_err or "quorum failed")
-            return
-
-        logger.info(
-            "[Replica %s] Finished quorum for group_rank %d",
-            self._replica_id,
-            group_rank,
-        )
+        # socket IO outside the server lock (a wedged client must not block
+        # the barrier for other ranks)
+        conn.settimeout(30.0)
         try:
-            reply = compute_quorum_results(
-                self._replica_id, group_rank, quorum, init_sync
+            if failure is not None:
+                send_error(conn, failure[0], failure[1])
+                return
+
+            if quorum is None:
+                send_error(conn, ErrCode.UNKNOWN, quorum_err or "quorum failed")
+                return
+
+            logger.info(
+                "[Replica %s] Finished quorum for group_rank %d",
+                self._replica_id,
+                group_rank,
             )
-        except WireError as e:
-            send_error(conn, e.code, str(e))
-            return
-        w = Writer()
-        reply.encode(w)
-        send_frame(conn, MsgType.MGR_QUORUM_RESP, w.payload())
+            try:
+                reply = compute_quorum_results(
+                    self._replica_id, group_rank, quorum, init_sync
+                )
+            except WireError as e:
+                send_error(conn, e.code, str(e))
+                return
+            w = Writer()
+            reply.encode(w)
+            send_frame(conn, MsgType.MGR_QUORUM_RESP, w.payload())
+        finally:
+            conn.settimeout(None)
 
     def _run_quorum(self, requester: QuorumMember, timeout_s: float) -> None:
         """Forward the group's request to the lighthouse with retries
@@ -459,24 +469,31 @@ class ManagerServer:
                 self._commit_gen += 1
                 self._lock.notify_all()
 
+            failure: Optional[Tuple[ErrCode, str]] = None
             while self._commit_gen == gen:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or self._shutdown:
-                    send_error(
-                        conn,
+                    failure = (
                         ErrCode.SHUTDOWN if self._shutdown else ErrCode.TIMEOUT,
                         f"should_commit for group_rank {group_rank} "
                         f"{'aborted by shutdown' if self._shutdown else 'timed out'}",
                     )
-                    return
+                    break
                 self._lock.wait(min(remaining, 0.1))
             decision = self._commit_decision
 
-        send_frame(
-            conn,
-            MsgType.MGR_SHOULD_COMMIT_RESP,
-            Writer().boolean(decision).payload(),
-        )
+        conn.settimeout(30.0)
+        try:
+            if failure is not None:
+                send_error(conn, failure[0], failure[1])
+                return
+            send_frame(
+                conn,
+                MsgType.MGR_SHOULD_COMMIT_RESP,
+                Writer().boolean(decision).payload(),
+            )
+        finally:
+            conn.settimeout(None)
 
 
 class ManagerClient(RpcClient):

@@ -1,0 +1,19 @@
+"""Model zoo for torchft_tpu examples, tests, and benchmarks."""
+
+_LAZY = {
+    "SimpleCNN": ("torchft_tpu.models.cnn", "SimpleCNN"),
+    "LlamaConfig": ("torchft_tpu.models.llama", "LlamaConfig"),
+    "Llama": ("torchft_tpu.models.llama", "Llama"),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)

@@ -1,0 +1,80 @@
+"""Toy CNN for CIFAR-sized inputs.
+
+The analog of the reference example model (``train_ddp.py:113-135``: a small
+conv net used to exercise the FT protocol, not to win benchmarks).  Pure
+functional jax: params are a pytree dict, ``apply`` is jit/pjit-friendly
+(static shapes, no Python control flow on traced values), convolutions lower
+to XLA convs that tile onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleCNN:
+    """conv3x3(32) → conv3x3(64) → maxpool → mlp, NHWC."""
+
+    def __init__(self, num_classes: int = 10, in_channels: int = 3) -> None:
+        self.num_classes = num_classes
+        self.in_channels = in_channels
+
+    def init(self, key: jax.Array, image_hw: Tuple[int, int] = (32, 32)) -> Dict[str, Any]:
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        h, w = image_hw
+        flat = (h // 4) * (w // 4) * 64
+
+        def _he(k, shape, fan_in):
+            return jax.random.normal(k, shape, dtype=jnp.float32) * np.sqrt(2.0 / fan_in)
+
+        return {
+            "conv1": {
+                "w": _he(k1, (3, 3, self.in_channels, 32), 9 * self.in_channels),
+                "b": jnp.zeros(32),
+            },
+            "conv2": {"w": _he(k2, (3, 3, 32, 64), 9 * 32), "b": jnp.zeros(64)},
+            "fc1": {"w": _he(k3, (flat, 128), flat), "b": jnp.zeros(128)},
+            "fc2": {"w": _he(k4, (128, self.num_classes), 128), "b": jnp.zeros(self.num_classes)},
+        }
+
+    @staticmethod
+    def apply(params: Dict[str, Any], x: jax.Array) -> jax.Array:
+        """x: [N, H, W, C] → logits [N, num_classes]."""
+
+        def conv(p, x):
+            out = jax.lax.conv_general_dilated(
+                x,
+                p["w"],
+                window_strides=(1, 1),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            return out + p["b"]
+
+        x = jax.nn.relu(conv(params["conv1"], x))
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+        )
+        x = jax.nn.relu(conv(params["conv2"], x))
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+        )
+        x = x.reshape(x.shape[0], -1)
+        x = jax.nn.relu(x @ params["fc1"]["w"] + params["fc1"]["b"])
+        return x @ params["fc2"]["w"] + params["fc2"]["b"]
+
+    @staticmethod
+    def loss(params: Dict[str, Any], batch: Tuple[jax.Array, jax.Array]) -> jax.Array:
+        x, y = batch
+        logits = SimpleCNN.apply(params, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    @staticmethod
+    def accuracy(params: Dict[str, Any], batch: Tuple[jax.Array, jax.Array]) -> jax.Array:
+        x, y = batch
+        return jnp.mean(jnp.argmax(SimpleCNN.apply(params, x), axis=1) == y)

@@ -1,0 +1,100 @@
+"""Optimizer wrapper: the whole per-step protocol in two verbs.
+
+Twin of the reference wrapper (``torchft/optim.py:24-63``) adapted to optax's
+functional style: ``start_step()`` (the reference's ``zero_grad``) computes
+the quorum, and ``apply()`` (the reference's ``step``) performs the optax
+update only when ``manager.should_commit()`` voted yes — failed steps leave
+params and optimizer state untouched, which is exactly how a discarded step
+stays invisible.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from torchft_tpu.manager import Manager
+
+
+class OptimizerWrapper:
+    """Wraps an ``optax.GradientTransformation`` with the FT step protocol.
+
+    Usage::
+
+        opt = OptimizerWrapper(manager, optax.adam(3e-4))
+        opt_state = opt.init(params)
+        for batch in data:
+            opt.start_step()                        # quorum (async) begins
+            grads, aux = grad_fn(params, batch)     # compiled forward/backward
+            grads = ft_allreduce(manager, grads)    # replica-dim average
+            params, opt_state, committed = opt.apply(params, opt_state, grads)
+    """
+
+    def __init__(self, manager: Manager, tx: Any) -> None:
+        self.manager = manager
+        self.tx = tx
+
+    def init(self, params: Any) -> Any:
+        return self.tx.init(params)
+
+    # -- the two verbs ------------------------------------------------------
+
+    def start_step(self, **kwargs: Any) -> None:
+        """Begin a step: compute quorum (``optim.py:48-50``)."""
+        self.manager.start_quorum(**kwargs)
+
+    # reference-compatible alias
+    zero_grad = start_step
+
+    def apply(
+        self,
+        params: Any,
+        opt_state: Any,
+        grads: Any,
+        refresh: Optional[Any] = None,
+    ) -> Tuple[Any, Any, bool]:
+        """Commit-gated optimizer step (``optim.py:52-55``).
+
+        Returns ``(params, opt_state, committed)``; on a failed vote the
+        inputs are returned unchanged and the step is discarded.
+
+        .. warning:: ``should_commit`` may *heal*: it applies a peer's
+           checkpoint through the registered ``load_state_dict`` fns.  Torch
+           params mutate in place so the reference gets the healed values for
+           free; jax pytrees are immutable, so if your load fn writes into a
+           holder, pass ``refresh=lambda: (params, opt_state)`` reading from
+           that holder — it is called *after* the vote so the update applies
+           to post-heal state.  (Or use :meth:`step` which handles this.)
+        """
+        if not self.manager.should_commit():
+            return params, opt_state, False
+        if refresh is not None:
+            params, opt_state = refresh()
+        params, opt_state = self._apply_update(params, opt_state, grads)
+        return params, opt_state, True
+
+    def step(self, holder: Any, grads: Any) -> bool:
+        """In-place-style verb (the reference's ``optimizer.step()``):
+        ``holder`` is a mutable mapping with ``"params"`` / ``"opt_state"``
+        keys — the same object your registered state_dict fns read/write, so
+        healing composes correctly.  Returns whether the step committed."""
+        if not self.manager.should_commit():
+            return False
+        params, opt_state = self._apply_update(
+            holder["params"], holder["opt_state"], grads
+        )
+        holder["params"] = params
+        holder["opt_state"] = opt_state
+        return True
+
+    def _apply_update(self, params: Any, opt_state: Any, grads: Any):
+        if not hasattr(self, "_cached_update"):
+            import optax
+
+            def _upd(params, opt_state, grads):
+                updates, new_state = self.tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), new_state
+
+            self._cached_update = jax.jit(_upd)
+        return self._cached_update(params, opt_state, grads)
