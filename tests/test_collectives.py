@@ -1,0 +1,158 @@
+"""Quantization + quantized collective tests (reference analogs:
+``quantization_test.py``, ``collectives_test.py`` — GPU-gated there, CPU
+here since our DCN tier is host-side)."""
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List
+
+import numpy as np
+import pytest
+
+from torchft_tpu.collectives import allreduce_quantized, reduce_scatter_quantized
+from torchft_tpu.communicator import TCPCommunicator
+from torchft_tpu.quantization import (
+    dequantize_int8_rowwise,
+    quantize_int8_rowwise,
+    reduce_quantized,
+)
+from torchft_tpu.store import StoreServer
+
+
+class TestQuantization:
+    def test_roundtrip_accuracy(self) -> None:
+        rng = np.random.default_rng(0)
+        flat = rng.normal(size=5000).astype(np.float32)
+        q, scales = quantize_int8_rowwise(flat, row_size=256)
+        restored = dequantize_int8_rowwise(q, scales, flat.size, np.float32)
+        # rowwise int8: error bounded by scale/2 per element
+        max_err = np.abs(restored - flat).max()
+        assert max_err <= np.abs(flat).max() / 127.0
+
+    def test_zero_row(self) -> None:
+        flat = np.zeros(100, dtype=np.float32)
+        q, scales = quantize_int8_rowwise(flat)
+        np.testing.assert_array_equal(
+            dequantize_int8_rowwise(q, scales, 100, np.float32), flat
+        )
+
+    def test_reduce_quantized(self) -> None:
+        rng = np.random.default_rng(1)
+        originals = [rng.normal(size=512).astype(np.float32) for _ in range(3)]
+        qs, scs = [], []
+        for o in originals:
+            q, s = quantize_int8_rowwise(o, row_size=128)
+            qs.append(q)
+            scs.append(s)
+        q_red, s_red = reduce_quantized(np.stack(qs), np.stack(scs))
+        total = dequantize_int8_rowwise(q_red, s_red, 512, np.float32)
+        expected = np.sum(originals, axis=0)
+        np.testing.assert_allclose(total, expected, atol=0.15)
+
+
+@pytest.fixture()
+def store():
+    server = StoreServer("127.0.0.1:0")
+    yield server
+    server.shutdown()
+
+
+def _run_ranks(store, world_size: int, fn: Callable) -> List[object]:
+    def _one(rank: int) -> object:
+        comm = TCPCommunicator(timeout_s=30.0)
+        comm.configure(
+            f"127.0.0.1:{store.port}/q",
+            replica_id=f"r{rank}",
+            rank=rank,
+            world_size=world_size,
+        )
+        try:
+            return fn(comm, rank)
+        finally:
+            comm.shutdown()
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        return list(pool.map(_one, range(world_size)))
+
+
+@pytest.mark.parametrize("world_size", [2, 3])
+def test_alltoall(store, world_size) -> None:
+    def _fn(comm, rank):
+        chunks = [
+            np.full(4, 10 * rank + p, dtype=np.float32) for p in range(world_size)
+        ]
+        return comm.alltoall(chunks).wait(timeout=30.0)
+
+    results = _run_ranks(store, world_size, _fn)
+    for rank, got in enumerate(results):
+        for src, arr in enumerate(got):
+            np.testing.assert_allclose(arr, np.full(4, 10 * src + rank))
+
+
+@pytest.mark.parametrize("world_size", [2, 4])
+def test_allgather(store, world_size) -> None:
+    def _fn(comm, rank):
+        return comm.allgather(np.full(5, float(rank), dtype=np.float32)).wait(
+            timeout=30.0
+        )
+
+    results = _run_ranks(store, world_size, _fn)
+    for got in results:
+        for src, arr in enumerate(got):
+            np.testing.assert_allclose(arr, np.full(5, float(src)))
+
+
+@pytest.mark.parametrize("world_size", [2, 3])
+def test_allreduce_quantized(store, world_size) -> None:
+    rng = np.random.default_rng(7)
+    inputs = [rng.normal(size=3000).astype(np.float32) for _ in range(world_size)]
+    expected = np.sum(inputs, axis=0)
+
+    def _fn(comm, rank):
+        return allreduce_quantized(comm, inputs[rank].copy()).wait(timeout=30.0)
+
+    results = _run_ranks(store, world_size, _fn)
+    scale = np.abs(expected).max()
+    for res in results:
+        assert res.dtype == np.float32
+        np.testing.assert_allclose(res, expected, atol=0.05 * scale)
+        # all ranks agree bit-exactly (same requantized stream)
+        np.testing.assert_array_equal(res, results[0])
+
+
+def test_allreduce_quantized_multi_buffer(store) -> None:
+    world_size = 2
+    rng = np.random.default_rng(9)
+    a = [rng.normal(size=(10, 7)).astype(np.float32) for _ in range(world_size)]
+    b = [rng.normal(size=33).astype(np.float32) for _ in range(world_size)]
+
+    def _fn(comm, rank):
+        return allreduce_quantized(comm, [a[rank].copy(), b[rank].copy()]).wait(
+            timeout=30.0
+        )
+
+    results = _run_ranks(store, world_size, _fn)
+    for res in results:
+        assert res[0].shape == (10, 7)
+        np.testing.assert_allclose(res[0], a[0] + a[1], atol=0.2)
+        np.testing.assert_allclose(res[1], b[0] + b[1], atol=0.2)
+
+
+def test_reduce_scatter_quantized(store) -> None:
+    world_size = 2
+    inputs = [
+        np.arange(4096, dtype=np.float32) * (r + 1) for r in range(world_size)
+    ]
+    expected = np.sum(inputs, axis=0)
+
+    def _fn(comm, rank):
+        return reduce_scatter_quantized(comm, inputs[rank].copy(), row_size=1024).wait(
+            timeout=30.0
+        )
+
+    results = _run_ranks(store, world_size, _fn)
+    # rank 0 owns the first half of rows, rank 1 the second
+    got = np.concatenate(results)[: expected.size]
+    # rowwise int8 double-quantization: error ≈ 1.5 quantization steps where
+    # a step is rowmax/127 (~96 for the largest row here)
+    atol = 1.5 * np.abs(expected).max() / 127.0
+    np.testing.assert_allclose(got, expected, rtol=0.02, atol=atol)

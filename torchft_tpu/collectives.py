@@ -1,0 +1,193 @@
+"""Quantized collectives: int8 allreduce over the replica dimension.
+
+The reference pipeline (``torchft/collectives.py:297-415``): quantize →
+``alltoall`` chunks → local dequant-reduce-requant → allgather → dequant.
+Per-rank bytes drop from ~2·n·4 (f32 ring) to ~2·n·1 + scales — the win that
+makes DiLoCo pseudogradient syncs viable over DCN bandwidth
+(``local_sgd.py`` ``should_quantize``).
+
+Like the reference (which chains the pipeline on a side CUDA stream,
+``collectives.py:369-415``), the pipeline here runs off-thread and returns a
+pending Work, so DiLoCo's τ-delay actually overlaps the sync with training.
+
+This is the host/DCN tier in numpy; the device-side quantize kernel (cutting
+HBM→host transfer to a quarter) is ``torchft_tpu.ops.pallas_quant``.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from torchft_tpu.communicator import Communicator
+from torchft_tpu.quantization import (
+    DEFAULT_ROW_SIZE,
+    dequantize_int8_rowwise,
+    quantize_int8_rowwise,
+    reduce_quantized,
+)
+from torchft_tpu.work import DummyWork, Work
+
+Buffers = Union[np.ndarray, List[np.ndarray]]
+
+
+def _pack(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """Payload + scales in one uint8 buffer so one collective carries both."""
+    return np.concatenate([q.reshape(-1).view(np.uint8), scales.view(np.uint8)])
+
+
+def _unpack(buf: np.ndarray, rows: int, row_size: int) -> Tuple[np.ndarray, np.ndarray]:
+    payload = rows * row_size
+    return (
+        buf[:payload].view(np.int8).reshape(rows, row_size),
+        buf[payload:].view(np.float32),
+    )
+
+
+def _quantized_reduce_scatter_sync(
+    comm: Communicator, flat: np.ndarray, row_size: int, tag: int
+) -> Tuple[np.ndarray, np.ndarray, int, int]:
+    """Core shared by both quantized collectives: quantize, pad rows to an
+    equal per-rank share, alltoall, dequant-sum-requant our shard.
+
+    Returns (reduced q shard, its scales, total unpadded rows, rows/rank).
+    """
+    ws = comm.size()
+    q, scales = quantize_int8_rowwise(flat, row_size)
+    rows = q.shape[0]
+    rows_per_rank = -(-rows // ws)
+    padded_rows = rows_per_rank * ws
+    if padded_rows != rows:
+        q = np.concatenate([q, np.zeros((padded_rows - rows, row_size), np.int8)])
+        scales = np.concatenate(
+            [scales, np.zeros(padded_rows - rows, np.float32)]
+        )
+
+    chunks = [
+        _pack(
+            q[p * rows_per_rank : (p + 1) * rows_per_rank],
+            scales[p * rows_per_rank : (p + 1) * rows_per_rank],
+        )
+        for p in range(ws)
+    ]
+    gathered = comm.alltoall(chunks, tag=tag).wait()
+
+    qs, scs = zip(*(_unpack(g, rows_per_rank, row_size) for g in gathered))
+    q_red, s_red = reduce_quantized(np.stack(qs), np.stack(scs))
+    return q_red, s_red, rows, rows_per_rank
+
+
+def _allreduce_quantized_sync(
+    comm: Communicator, arrays: List[np.ndarray], row_size: int
+) -> List[np.ndarray]:
+    layout = [(a.shape, a.dtype, a.size) for a in arrays]
+    flat = np.concatenate(
+        [np.asarray(a, dtype=np.float32).reshape(-1) for a in arrays]
+    )
+
+    pipeline_err: Optional[BaseException] = None
+    try:
+        q_red, s_red, rows, rows_per_rank = _quantized_reduce_scatter_sync(
+            comm, flat, row_size, tag=101
+        )
+    except BaseException as e:  # noqa: BLE001
+        # Injected/future errors must not skip the remaining collective —
+        # peers would wedge in their allgather (FakeCommunicatorWrapper
+        # contract). Participate with a zero shard, then re-raise.
+        pipeline_err = e
+        ws = comm.size()
+        rows = max(1, -(-flat.size // row_size))
+        rows_per_rank = -(-rows // ws)
+        q_red = np.zeros((rows_per_rank, row_size), np.int8)
+        s_red = np.zeros(rows_per_rank, np.float32)
+
+    all_shards = comm.allgather(_pack(q_red, s_red), tag=102).wait()
+    if pipeline_err is not None:
+        raise pipeline_err
+
+    row_size_ = q_red.shape[1]
+    qs_full, ss_full = zip(
+        *(_unpack(s, rows_per_rank, row_size_) for s in all_shards)
+    )
+    q_full = np.concatenate(qs_full)[:rows]
+    s_full = np.concatenate(ss_full)[:rows]
+    summed = dequantize_int8_rowwise(q_full, s_full, flat.size, np.float32)
+
+    out: List[np.ndarray] = []
+    off = 0
+    for shape, dtype, size in layout:
+        out.append(
+            summed[off : off + size].reshape(shape).astype(dtype, copy=False)
+        )
+        off += size
+    return out
+
+
+def allreduce_quantized(
+    comm: Communicator,
+    buffers: Buffers,
+    row_size: int = DEFAULT_ROW_SIZE,
+) -> Work:
+    """SUM-allreduce through int8: the Work's value mirrors ``buffers`` with
+    summed float values (the Manager divides by participants afterwards,
+    exactly like the unquantized path).
+
+    Accuracy: rowwise int8 carries ~2-3 decimal digits; intended for DiLoCo
+    pseudogradients where the outer optimizer tolerates it (the reference
+    ships fp8 with the same caveat).
+    """
+    single = isinstance(buffers, np.ndarray)
+    arrays: List[np.ndarray] = [buffers] if single else list(buffers)
+
+    if comm.size() == 1:
+        return DummyWork(arrays[0] if single else arrays)
+
+    fut: Future = Future()
+
+    def _run() -> None:
+        try:
+            out = _allreduce_quantized_sync(comm, arrays, row_size)
+            fut.set_result(out[0] if single else out)
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(
+        target=_run, name="tpuft_quantized_allreduce", daemon=True
+    ).start()
+    return Work(fut)
+
+
+def reduce_scatter_quantized(
+    comm: Communicator,
+    buffers: Buffers,
+    row_size: int = DEFAULT_ROW_SIZE,
+) -> Work:
+    """Quantized reduce-scatter (``collectives.py:159-294``): each rank gets
+    the dequantized sum of its row-shard only (flat float32)."""
+    single = isinstance(buffers, np.ndarray)
+    arrays: List[np.ndarray] = [buffers] if single else list(buffers)
+    flat = np.concatenate(
+        [np.asarray(a, dtype=np.float32).reshape(-1) for a in arrays]
+    )
+    if comm.size() == 1:
+        return DummyWork(flat)
+
+    fut: Future = Future()
+
+    def _run() -> None:
+        try:
+            q_red, s_red, _rows, rows_per_rank = _quantized_reduce_scatter_sync(
+                comm, flat, row_size, tag=103
+            )
+            total = (q_red.astype(np.float32) * s_red[:, None]).reshape(-1)
+            fut.set_result(total)
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(
+        target=_run, name="tpuft_quantized_reduce_scatter", daemon=True
+    ).start()
+    return Work(fut)

@@ -123,6 +123,12 @@ class Communicator(ABC):
     def barrier(self) -> Work:
         ...
 
+    def alltoall(self, chunks: List[np.ndarray], tag: int = 0) -> Work:
+        raise NotImplementedError
+
+    def allgather(self, data: np.ndarray, tag: int = 0) -> Work:
+        raise NotImplementedError
+
     @abstractmethod
     def abort(self, reason: str = "aborted") -> None:
         ...
@@ -656,6 +662,66 @@ class TCPCommunicator(Communicator):
 
         return self._submit(_make)
 
+    def _all_exchange(
+        self,
+        send_for_peer: Callable[[int], np.ndarray],
+        recv_template: Callable[[int], np.ndarray],
+        own: np.ndarray,
+        tag: int,
+    ) -> Work:
+        """Shared skeleton for alltoall/allgather: send ``send_for_peer(p)``
+        to every peer, receive into ``empty_like(recv_template(p))``, pass
+        our own buffer through at index ``rank``."""
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                ws, rank = ctx.world_size, ctx.rank
+                if ws == 1:
+                    return [own]
+                mesh = ctx.mesh
+                assert mesh is not None
+                out = [np.empty_like(recv_template(p)) for p in range(ws)]
+                out[rank] = own
+                sends = [
+                    (p, tag, _bytes_view(send_for_peer(p)))
+                    for p in range(ws)
+                    if p != rank
+                ]
+                recvs = [
+                    (p, tag, _bytes_view(out[p])) for p in range(ws) if p != rank
+                ]
+                mesh.exchange(sends, recvs, ctx.deadline())
+                return out
+
+            return _run
+
+        return self._submit(_make)
+
+    def alltoall(self, chunks: List[np.ndarray], tag: int = 0) -> Work:
+        """Exchange ``chunks[j]`` with rank j (keeping our own); the Work's
+        value is the list of received chunks indexed by source rank.  Chunk j
+        must have the shape rank j expects back (symmetric splits)."""
+        arrays = [np.ascontiguousarray(c) for c in chunks]
+        assert len(arrays) == self._world_size, "need one chunk per rank"
+        rank = self._rank
+        return self._all_exchange(
+            send_for_peer=lambda p: arrays[p],
+            recv_template=lambda p: arrays[p],
+            own=arrays[rank],
+            tag=4000 + tag,
+        )
+
+    def allgather(self, data: np.ndarray, tag: int = 0) -> Work:
+        """Gather every rank's buffer (same shape/dtype on all ranks); the
+        Work's value is a list indexed by rank."""
+        array = np.ascontiguousarray(data)
+        return self._all_exchange(
+            send_for_peer=lambda p: array,
+            recv_template=lambda p: array,
+            own=array,
+            tag=5000 + tag,
+        )
+
     def barrier(self) -> Work:
         def _make(ctx: "_CommCtx") -> Callable[[], object]:
             def _run() -> object:
@@ -830,6 +896,14 @@ class DummyCommunicator(Communicator):
     def recv_bytes(self, src: int, tag: int = 0) -> Work:
         return DummyWork(b"")
 
+    def alltoall(self, chunks, tag: int = 0) -> Work:
+        # passthrough semantics at the configured world size, matching the
+        # allreduce passthrough: every "peer's" contribution is our own
+        return DummyWork(list(chunks))
+
+    def allgather(self, data, tag: int = 0) -> Work:
+        return DummyWork([data] * self._world_size)
+
     def barrier(self) -> Work:
         return DummyWork(None)
 
@@ -886,6 +960,12 @@ class FakeCommunicatorWrapper(Communicator):
 
     def recv_bytes(self, src: int, tag: int = 0) -> Work:
         return self._wrap(self._comm.recv_bytes(src, tag))
+
+    def alltoall(self, chunks, tag: int = 0) -> Work:
+        return self._wrap(self._comm.alltoall(chunks, tag))
+
+    def allgather(self, data, tag: int = 0) -> Work:
+        return self._wrap(self._comm.allgather(data, tag))
 
     def barrier(self) -> Work:
         return self._wrap(self._comm.barrier())

@@ -1,0 +1,334 @@
+"""LocalSGD and (Streaming) DiLoCo: communication-reduced fault-tolerant DP.
+
+Behavioral twins of the reference wrappers (``torchft/local_sgd.py``):
+
+- :class:`LocalSGD` (``local_sgd.py:45-172``): train locally for
+  ``sync_every`` steps, then average *parameters* across replicas and commit.
+- :class:`DiLoCo` (``local_sgd.py:175-795``): the DiLoCo / Streaming DiLoCo
+  algorithm — keep a host-side backup of the globally-synced parameters;
+  every ``sync_every`` steps compute **pseudogradients** (backup − local),
+  average them across replicas (optionally int8-quantized over DCN), step an
+  **outer optimizer** on the backup, and mix local/global by
+  ``fragment_update_alpha``.  The model is split into fragments whose syncs
+  are staggered and overlapped with training (the streaming variant's τ =
+  ``fragment_sync_delay``).
+
+jax adaptation: model state lives in a mutable ``holder`` mapping
+(``{"params": pytree, ...}``) — the same object registered with the Manager
+for healing.  Fragments are index sets over the flattened params, split by
+byte size rather than by module boundaries (the reference carves fragments
+with torch pipelining; leaf groups are the natural jax equivalent).  Backups
+are host numpy (the reference pins them to CPU, ``local_sgd.py:241-253``);
+pseudogradient math runs on host, the outer optimizer step runs through
+optax.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from torchft_tpu.manager import Manager
+
+logger = logging.getLogger(__name__)
+
+
+def _to_host(leaves: Sequence[Any]) -> List[np.ndarray]:
+    return [np.asarray(leaf) for leaf in leaves]
+
+
+def _like_leaf(value: np.ndarray, ref: Any) -> Any:
+    """Return ``value`` with the container type/placement of ``ref``."""
+    if isinstance(ref, jax.Array):
+        return jax.device_put(value, ref.sharding)
+    return value
+
+
+def partition_leaves(
+    params: Any, num_fragments: int
+) -> List[List[int]]:
+    """Split the flattened leaves of ``params`` into ``num_fragments``
+    contiguous groups of roughly equal byte size."""
+    leaves = jax.tree_util.tree_leaves(params)
+    sizes = [int(np.asarray(leaf).nbytes) for leaf in leaves]
+    total = sum(sizes)
+    target = total / max(num_fragments, 1)
+    groups: List[List[int]] = [[] for _ in range(num_fragments)]
+    acc, g = 0.0, 0
+    for i, size in enumerate(sizes):
+        if g < num_fragments - 1 and acc >= target * (g + 1):
+            g += 1
+        groups[g].append(i)
+        acc += size
+    if any(not group for group in groups):
+        raise ValueError(
+            f"cannot split {len(leaves)} leaves into {num_fragments} fragments"
+        )
+    return groups
+
+
+class LocalSGD:
+    """Parameter-averaging LocalSGD (``local_sgd.py:45-172``).
+
+    Usage::
+
+        local_sgd = LocalSGD(manager, holder, sync_every=32)
+        with local_sgd:
+            for batch in data:
+                ...inner optimizer step on holder...
+                local_sgd.step()
+    """
+
+    def __init__(self, manager: Manager, holder: Dict[str, Any], sync_every: int) -> None:
+        if sync_every < 1:
+            raise ValueError("sync_every must be >= 1")
+        self._manager = manager
+        self._holder = holder
+        self._sync_every = sync_every
+        self._local_step = 0
+
+    def __enter__(self) -> "LocalSGD":
+        return self
+
+    def __exit__(self, *exc: object) -> bool:
+        return False
+
+    def step(self) -> Optional[bool]:
+        """Call after every inner optimizer step; returns the commit decision
+        on sync steps, None otherwise."""
+        self._local_step += 1
+        if self._local_step < self._sync_every:
+            return None
+        self._local_step = 0
+        return self.sync()
+
+    def sync(self) -> bool:
+        """Average parameters across replicas and commit
+        (``local_sgd.py:129-172``)."""
+        self._manager.start_quorum()
+        params = self._holder["params"]
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        host = _to_host(leaves)
+        work = self._manager.allreduce(host)
+        averaged = work.wait()
+        committed = self._manager.should_commit()
+        if committed:
+            new_leaves = [
+                _like_leaf(avg, leaf) for avg, leaf in zip(averaged, leaves)
+            ]
+            self._holder["params"] = jax.tree_util.tree_unflatten(
+                treedef, new_leaves
+            )
+        return committed
+
+
+class _Fragment:
+    """One streaming fragment (``_StreamingDiLoCoFragment``,
+    ``local_sgd.py:175-566``): backup params, pseudogradients, outer
+    optimizer state, alpha mixing."""
+
+    def __init__(
+        self,
+        manager: Manager,
+        holder: Dict[str, Any],
+        index: int,
+        leaf_idxs: List[int],
+        outer_tx: Any,
+        should_quantize: bool,
+        fragment_update_alpha: float,
+    ) -> None:
+        self._manager = manager
+        self._holder = holder
+        self._index = index
+        self._leaf_idxs = leaf_idxs
+        self._outer_tx = outer_tx
+        self._should_quantize = should_quantize
+        self._alpha = fragment_update_alpha
+        self._work = None
+
+        backup = self._current_local()
+        self.backup: List[np.ndarray] = [np.array(a, copy=True) for a in backup]
+        self.outer_state = outer_tx.init(self.backup)
+
+        # fragment state rides the healing checkpoint
+        # (``local_sgd.py:255-286``)
+        key = f"StreamingDiLoCoFragment_{index}"
+        manager.register_state_dict_fn(key, self._load_state, self._save_state)
+
+    def _save_state(self) -> Dict[str, Any]:
+        return {"backup": self.backup, "outer_state": self.outer_state}
+
+    def _load_state(self, state: Dict[str, Any]) -> None:
+        self.backup = [np.asarray(a) for a in state["backup"]]
+        self.outer_state = state["outer_state"]
+
+    def _current_local(self) -> List[np.ndarray]:
+        leaves = jax.tree_util.tree_leaves(self._holder["params"])
+        return [np.asarray(leaves[i]) for i in self._leaf_idxs]
+
+    def save_parameters(self) -> None:
+        self.backup = [np.array(a, copy=True) for a in self._current_local()]
+
+    def prepare_sync(self) -> None:
+        """pseudogradient = backup − local, then async average
+        (``local_sgd.py:401-420``)."""
+        local = self._current_local()
+        pseudograds = [b - l for b, l in zip(self.backup, local)]
+        assert self._work is None, "fragment already has an allreduce in flight"
+        self._work = self._manager.allreduce(
+            pseudograds, should_quantize=self._should_quantize
+        )
+
+    def perform_sync(self) -> bool:
+        """Wait for the averaged pseudogradients, vote, and apply the outer
+        step (``local_sgd.py:422-475``)."""
+        assert self._work is not None, "prepare_sync must run first"
+        averaged = self._work.wait()
+        self._work = None
+
+        local = self._current_local()
+        committed = self._manager.should_commit()
+
+        leaves, treedef = jax.tree_util.tree_flatten(self._holder["params"])
+        if committed:
+            import optax
+
+            updates, self.outer_state = self._outer_tx.update(
+                averaged, self.outer_state, self.backup
+            )
+            global_params = optax.apply_updates(self.backup, updates)
+            global_params = [np.asarray(g) for g in global_params]
+            # model = (1−α)·global + α·local (``local_sgd.py:366-384``)
+            for j, i in enumerate(self._leaf_idxs):
+                mixed = (
+                    global_params[j]
+                    if self._alpha == 0.0
+                    else (1.0 - self._alpha) * global_params[j]
+                    + self._alpha * local[j]
+                ).astype(local[j].dtype)
+                leaves[i] = _like_leaf(mixed, leaves[i])
+            self.backup = global_params
+        else:
+            # failed sync: reset to the last globally-consistent state so we
+            # never overtrain on unsynced data (``local_sgd.py:785-790``)
+            for j, i in enumerate(self._leaf_idxs):
+                leaves[i] = _like_leaf(self.backup[j], leaves[i])
+        self._holder["params"] = jax.tree_util.tree_unflatten(treedef, leaves)
+        return committed
+
+
+class DiLoCo:
+    """(Streaming) DiLoCo (``local_sgd.py:569-795``).
+
+    Usage::
+
+        manager = Manager(..., use_async_quorum=False)
+        diloco = DiLoCo(manager, holder, outer_tx=optax.sgd(0.7, momentum=0.9,
+                        nesterov=True), sync_every=20, num_fragments=2)
+        with diloco:
+            for batch in data:
+                ...inner optimizer step on holder...
+                diloco.step()
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        holder: Dict[str, Any],
+        outer_tx: Union[Any, List[Any]],
+        sync_every: int,
+        num_fragments: int = 1,
+        fragments: Optional[List[List[int]]] = None,
+        should_quantize: bool = False,
+        fragment_sync_delay: int = 0,
+        fragment_update_alpha: float = 0.0,
+    ) -> None:
+        if manager._use_async_quorum:
+            raise ValueError(
+                "DiLoCo requires synchronous quorum: construct the Manager "
+                "with use_async_quorum=False"
+            )
+        if fragments is None:
+            fragments = partition_leaves(holder["params"], num_fragments)
+        n = len(fragments)
+        if sync_every < n:
+            raise ValueError("Only 1 fragment can be synchronized at a time")
+        if sync_every % n != 0:
+            raise ValueError("sync_every must be divisible by the fragment count")
+        self._sync_every = sync_every // n
+        if fragment_sync_delay >= self._sync_every:
+            raise ValueError("Fragment must be synced before it is reduced again")
+        if not 0.0 <= fragment_update_alpha <= 1.0:
+            raise ValueError("fragment_update_alpha must be between 0 and 1")
+
+        self._manager = manager
+        self._holder = holder
+        self._local_step = 0
+        self._fragment_sync_delay = fragment_sync_delay
+
+        outer_txs = (
+            outer_tx if isinstance(outer_tx, list) else [outer_tx] * n
+        )
+        if len(outer_txs) != n:
+            raise ValueError("need one outer optimizer per fragment")
+        self._fragments = [
+            _Fragment(
+                manager,
+                holder,
+                i,
+                leaf_idxs,
+                outer_txs[i],
+                should_quantize,
+                fragment_update_alpha,
+            )
+            for i, leaf_idxs in enumerate(fragments)
+        ]
+
+    def __enter__(self) -> "DiLoCo":
+        return self
+
+    def __exit__(self, *exc: object) -> bool:
+        return False
+
+    def _current_fragment(self) -> int:
+        """All replicas must prepare/sync fragments in the same order to
+        avoid cross-replica deadlock (``local_sgd.py:745-763``)."""
+        return self._manager.current_step() % len(self._fragments)
+
+    def step(self) -> Optional[bool]:
+        """Call after every inner optimizer step (the reference's optimizer
+        post-hook, ``local_sgd.py:745-795``); returns the commit decision on
+        sync steps, None otherwise."""
+        self._local_step += 1
+
+        if self._local_step == self._sync_every - self._fragment_sync_delay:
+            # quorum + overlap the pseudogradient allreduce with the next τ
+            # inner steps
+            self._manager.start_quorum()
+            fragment = self._current_fragment()
+            logger.info(
+                "Preparing fragment=%d step=%d", fragment, self._local_step
+            )
+            self._fragments[fragment].prepare_sync()
+            if self._fragment_sync_delay > 0:
+                return None
+
+        if self._local_step < self._sync_every:
+            return None
+
+        assert self._local_step == self._sync_every, (
+            f"local_step={self._local_step} overran sync_every={self._sync_every}"
+        )
+        fragment = self._current_fragment()
+        logger.info(
+            "Syncing fragment=%d step=%d manager_step=%d",
+            fragment,
+            self._local_step,
+            self._manager.current_step(),
+        )
+        committed = self._fragments[fragment].perform_sync()
+        self._local_step = 0
+        return committed

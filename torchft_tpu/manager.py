@@ -536,11 +536,13 @@ class Manager:
         num_participants = self.num_participants()
 
         if not self.is_participating():
+            # contribute zeros (the reference zeroes the grad tensors in
+            # place, ``manager.py:441-442``; inputs here may be read-only
+            # jax views, so swap in zero buffers instead)
             if isinstance(data, np.ndarray):
-                data.fill(0)
+                data = np.zeros_like(data)
             else:
-                for a in data:
-                    a.fill(0)
+                data = [np.zeros_like(a) for a in data]
 
         try:
             if should_quantize:
@@ -693,12 +695,14 @@ class Manager:
 
 
 def _div(a: np.ndarray, n: int) -> np.ndarray:
-    # integer grads floor-divide; everything else (incl. extension float
-    # dtypes like bfloat16, which are NOT np.inexact subdtypes) true-divides
+    # Always out-of-place: the communicator may return the caller's own
+    # buffer aliased (DummyCommunicator passthrough), and mutating it would
+    # silently corrupt a retained gradient. Integer grads floor-divide;
+    # everything else (incl. extension float dtypes like bfloat16, which are
+    # NOT np.inexact subdtypes) true-divides.
     if np.issubdtype(a.dtype, np.integer):
         return a // n
-    np.divide(a, n, out=a)
-    return a
+    return (a / n).astype(a.dtype)
 
 
 class _ManagerLogger:

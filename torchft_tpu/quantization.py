@@ -1,0 +1,64 @@
+"""Rowwise int8 quantization for bandwidth-reduced collectives.
+
+The reference fuses fp8 quantize/dequantize/reduce into triton kernels
+(``torchft/quantization.py:44-686``, CUDA-only).  torchft_tpu's replica-dim
+collectives run host-side over DCN, so the wire format lives here as
+vectorized numpy; the device-side (Pallas) quantize kernel that reduces
+HBM→host transfer bytes lives in ``torchft_tpu/ops/``.
+
+Wire format per buffer: the flat array is viewed as rows of ``row_size``
+elements (last row padded); each row is scaled by ``max(|row|)/127`` into
+int8.  Scales travel as float32 alongside the payload, mirroring the
+reference's interleaved rowwise-scale layout.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+DEFAULT_ROW_SIZE = 1024
+
+
+def quantize_int8_rowwise(
+    flat: np.ndarray, row_size: int = DEFAULT_ROW_SIZE
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Quantize a flat float array → (int8 payload [rows, row_size],
+    float32 scales [rows]). The payload is padded to a whole row."""
+    assert flat.ndim == 1
+    n = flat.size
+    rows = max(1, -(-n // row_size))
+    padded = np.zeros(rows * row_size, dtype=np.float32)
+    padded[:n] = flat.astype(np.float32, copy=False)
+    padded = padded.reshape(rows, row_size)
+    absmax = np.abs(padded).max(axis=1)
+    scales = (absmax / 127.0).astype(np.float32)
+    safe = np.where(scales > 0, scales, 1.0)
+    q = np.clip(np.rint(padded / safe[:, None]), -127, 127).astype(np.int8)
+    return q, scales
+
+
+def dequantize_int8_rowwise(
+    q: np.ndarray, scales: np.ndarray, n: int, dtype: np.dtype
+) -> np.ndarray:
+    """Inverse of :func:`quantize_int8_rowwise`, truncated to ``n``."""
+    out = (q.astype(np.float32) * scales[:, None]).reshape(-1)[:n]
+    return out.astype(dtype, copy=False)
+
+
+def reduce_quantized(
+    qs: np.ndarray, scales: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Sum ``w`` quantized copies: qs [w, rows, row_size], scales [w, rows]
+    → requantized (q [rows, row_size], scales [rows]) of the float sum.
+
+    The accumulate happens in float32 (the analog of the reference's
+    ``fused_reduce_fp8`` dequant-sum-requant, ``quantization.py:638``).
+    """
+    total = (qs.astype(np.float32) * scales[:, :, None]).sum(axis=0)
+    absmax = np.abs(total).max(axis=1)
+    out_scales = (absmax / 127.0).astype(np.float32)
+    safe = np.where(out_scales > 0, out_scales, 1.0)
+    q = np.clip(np.rint(total / safe[:, None]), -127, 127).astype(np.int8)
+    return q, out_scales
