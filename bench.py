@@ -1,0 +1,158 @@
+"""Benchmark: fault-tolerance overhead on the flagship model.
+
+Measures tokens/sec/chip for (a) a plain jitted train loop and (b) the full
+fault-tolerant stack — in-process lighthouse + manager server + per-step
+quorum/commit RPCs + host-side replica-dim gradient averaging — on the same
+chip, and reports the FT/fault-free throughput ratio.  The north-star target
+(BASELINE.json) is sustaining ≥95% of fault-free throughput, so
+``vs_baseline = ratio / 0.95`` (≥1 is at/above target).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Env knobs: TPUFT_BENCH_STEPS, TPUFT_BENCH_DIM, TPUFT_BENCH_LAYERS,
+TPUFT_BENCH_SEQ, TPUFT_BENCH_BATCH, TPUFT_BENCH_PLATFORM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    platform = os.environ.get("TPUFT_BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    # persistent compile cache: bench reruns skip the slow first compile
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import optax
+
+    from torchft_tpu.communicator import TCPCommunicator
+    from torchft_tpu.ddp import ft_allreduce
+    from torchft_tpu.lighthouse import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.models.llama import Llama, LlamaConfig
+    from torchft_tpu.optim import OptimizerWrapper
+
+    steps = int(os.environ.get("TPUFT_BENCH_STEPS", 20))
+    dim = int(os.environ.get("TPUFT_BENCH_DIM", 512))
+    layers = int(os.environ.get("TPUFT_BENCH_LAYERS", 8))
+    seq = int(os.environ.get("TPUFT_BENCH_SEQ", 1024))
+    batch = int(os.environ.get("TPUFT_BENCH_BATCH", 8))
+
+    config = LlamaConfig(
+        vocab_size=8192,
+        dim=dim,
+        n_layers=layers,
+        n_heads=max(1, dim // 64),
+        n_kv_heads=max(1, dim // 128),
+        ffn_hidden=dim * 3,
+        max_seq_len=seq,
+        dtype=jnp.bfloat16,
+    )
+    model = Llama(config)
+    device = jax.devices()[0]
+    print(
+        f"bench: llama dim={dim} layers={layers} seq={seq} batch={batch} "
+        f"params={model.num_params()/1e6:.1f}M on {device.platform}",
+        file=sys.stderr,
+    )
+
+    params = jax.device_put(model.init(jax.random.PRNGKey(0)), device)
+    tx = optax.adamw(1e-3)
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (batch, seq), 0, config.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    batch_data = (jax.device_put(tokens, device), jax.device_put(targets, device))
+    tokens_per_step = batch * seq
+
+    grad_step = jax.jit(jax.value_and_grad(model.loss))
+
+    def update_fn(params, opt_state, grads):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    update_step = jax.jit(update_fn, donate_argnums=(0, 1))
+
+    # ---------------- fault-free baseline ----------------
+    # deep copy: update_step donates its inputs, and the FT phase below must
+    # not read donated buffers
+    ff_params = jax.tree_util.tree_map(jnp.copy, params)
+    opt_state = jax.jit(tx.init)(ff_params)
+    loss, grads = grad_step(ff_params, batch_data)  # compile
+    ff_params, opt_state = update_step(ff_params, opt_state, grads)
+    jax.block_until_ready(ff_params)
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        loss, grads = grad_step(ff_params, batch_data)
+        ff_params, opt_state = update_step(ff_params, opt_state, grads)
+    jax.block_until_ready(ff_params)
+    faultfree_s = (time.perf_counter() - start) / steps
+    faultfree_tps = tokens_per_step / faultfree_s
+    print(f"fault-free: {faultfree_s*1e3:.1f} ms/step, {faultfree_tps:,.0f} tok/s", file=sys.stderr)
+
+    # ---------------- full FT stack ----------------
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=50, quorum_tick_ms=20
+    )
+    holder = {"params": params, "opt_state": jax.jit(tx.init)(params)}
+    manager = Manager(
+        comm=TCPCommunicator(timeout_s=60.0),
+        load_state_dict=lambda s: holder.update(s),
+        state_dict=lambda: dict(holder),
+        min_replica_size=1,
+        replica_id="bench_0",
+        lighthouse_addr=lighthouse.local_address(),
+    )
+    opt = OptimizerWrapper(manager, tx)
+
+    def ft_step() -> None:
+        opt.start_step()
+        loss, grads = grad_step(holder["params"], batch_data)
+        grads = ft_allreduce(manager, grads)
+        opt.step(holder, grads)
+
+    ft_step()  # warm the protocol path
+    jax.block_until_ready(holder["params"])
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        ft_step()
+    jax.block_until_ready(holder["params"])
+    ft_s = (time.perf_counter() - start) / steps
+    ft_tps = tokens_per_step / ft_s
+    print(f"ft: {ft_s*1e3:.1f} ms/step, {ft_tps:,.0f} tok/s", file=sys.stderr)
+
+    manager.shutdown()
+    lighthouse.shutdown()
+
+    ratio = ft_tps / faultfree_tps
+    print(
+        json.dumps(
+            {
+                "metric": "ft_vs_faultfree_tokens_per_sec_ratio",
+                "value": round(ratio, 4),
+                "unit": "ratio",
+                "vs_baseline": round(ratio / 0.95, 4),
+                "faultfree_tokens_per_sec": round(faultfree_tps, 1),
+                "ft_tokens_per_sec": round(ft_tps, 1),
+                "platform": device.platform,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

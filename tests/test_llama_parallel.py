@@ -1,0 +1,204 @@
+"""Llama + parallelism tests on the virtual 8-device CPU mesh.
+
+Covers: model forward/loss correctness, dp×fsdp×tp sharded training, ring
+attention (sp) equivalence inside the full model, HSDP trainer with the FT
+manager, and sharded healing.
+"""
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchft_tpu.communicator import DummyCommunicator
+from torchft_tpu.manager import Manager
+from torchft_tpu.models.llama import Llama, llama_debug
+from torchft_tpu.parallel.hsdp import (
+    HSDPTrainer,
+    fsdp_shardings,
+    make_grad_step,
+    shard_init,
+)
+from torchft_tpu.parallel.mesh import MeshAxes, make_mesh
+from torchft_tpu.parallel.ring_attention import ring_attention_sharded
+
+from tests.test_manager import MemoryTransport, StubClient, _quorum_result
+
+
+def _batch(config, batch=2, seq=32, seed=0):
+    key = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(key, (batch, seq), 0, config.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    return tokens, targets
+
+
+class TestLlamaModel:
+    def test_forward_shapes(self) -> None:
+        config = llama_debug()
+        model = Llama(config)
+        params = model.init(jax.random.PRNGKey(0))
+        tokens, targets = _batch(config)
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 32, config.vocab_size)
+        assert logits.dtype == jnp.float32
+        loss = model.loss(params, (tokens, targets))
+        # near-uniform at init
+        assert abs(float(loss) - np.log(config.vocab_size)) < 1.0
+
+    def test_causality(self) -> None:
+        """Future-token perturbations must not change earlier logits."""
+        config = llama_debug()
+        model = Llama(config)
+        params = model.init(jax.random.PRNGKey(0))
+        tokens, _ = _batch(config)
+        logits_a = model.apply(params, tokens)
+        tokens_b = tokens.at[:, -1].set((tokens[:, -1] + 1) % config.vocab_size)
+        logits_b = model.apply(params, tokens_b)
+        np.testing.assert_allclose(
+            np.asarray(logits_a[:, :-1]), np.asarray(logits_b[:, :-1]), atol=1e-5
+        )
+
+    def test_num_params_matches(self) -> None:
+        config = llama_debug()
+        model = Llama(config)
+        params = model.init(jax.random.PRNGKey(0))
+        actual = sum(np.asarray(l).size for l in jax.tree_util.tree_leaves(params))
+        assert actual == model.num_params()
+
+    def test_training_reduces_loss(self) -> None:
+        config = llama_debug()
+        model = Llama(config)
+        params = model.init(jax.random.PRNGKey(0))
+        batch = _batch(config)
+        tx = optax.adam(1e-3)
+        opt_state = tx.init(params)
+        step = jax.jit(jax.value_and_grad(model.loss))
+        first = None
+        for _ in range(5):
+            loss, grads = step(params, batch)
+            if first is None:
+                first = float(loss)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        assert float(loss) < first
+
+
+class TestShardedLlama:
+    def test_hsdp_sharded_matches_single_device(self) -> None:
+        """dp×fsdp×tp sharded loss == unsharded loss (same math, SPMD)."""
+        config = llama_debug()
+        model = Llama(config)
+        params = model.init(jax.random.PRNGKey(0))
+        batch = _batch(config, batch=4)
+        dense_loss = float(model.loss(params, batch))
+
+        mesh = make_mesh(dp=2, fsdp=2, tp=2)
+        params_sh, batch_sh = fsdp_shardings(model, mesh)
+        params_s = jax.tree_util.tree_map(
+            lambda leaf, sh: jax.device_put(leaf, sh), params, params_sh
+        )
+        batch_s = tuple(
+            jax.device_put(b, sh) for b, sh in zip(batch, batch_sh)
+        )
+        with mesh:
+            loss_s = jax.jit(model.loss)(params_s, batch_s)
+        assert abs(float(loss_s) - dense_loss) < 1e-4
+
+    def test_grad_step_outputs_sharded(self) -> None:
+        config = llama_debug()
+        model = Llama(config)
+        mesh = make_mesh(dp=2, fsdp=2, tp=2)
+        params = shard_init(model, jax.random.PRNGKey(0), mesh)
+        # spot-check a megatron layout: wq sharded on fsdp/tp
+        wq_shard = params["layers"]["wq"].sharding.spec
+        assert wq_shard == P(None, "fsdp", "tp")
+        grad_step = make_grad_step(model, mesh)
+        batch = _batch(config, batch=4)
+        batch_sh = fsdp_shardings(model, mesh)[1]
+        batch_s = tuple(jax.device_put(b, sh) for b, sh in zip(batch, batch_sh))
+        loss, grads = grad_step(params, batch_s)
+        assert grads["layers"]["wq"].sharding.spec == P(None, "fsdp", "tp")
+        assert np.isfinite(float(loss))
+
+    def test_sp_ring_attention_full_model(self) -> None:
+        """Full model with sp=4 ring attention == dense attention model."""
+        config_dense = llama_debug()
+        mesh = make_mesh(dp=1, fsdp=1, tp=2, sp=4)
+        config_sp = llama_debug(sp_axis="sp")
+        model_dense = Llama(config_dense)
+        model_sp = Llama(config_sp, mesh=mesh)
+        params = model_dense.init(jax.random.PRNGKey(0))
+        tokens, targets = _batch(config_dense, batch=2, seq=64)
+        ref_loss = float(model_dense.loss(params, (tokens, targets)))
+
+        params_sh, batch_sh = fsdp_shardings(model_sp, mesh)
+        params_s = jax.tree_util.tree_map(
+            lambda leaf, sh: jax.device_put(leaf, sh), params, params_sh
+        )
+        batch_s = tuple(
+            jax.device_put(b, sh) for b, sh in zip((tokens, targets), batch_sh)
+        )
+        with mesh:
+            sp_loss = jax.jit(model_sp.loss)(params_s, batch_s)
+        assert abs(float(sp_loss) - ref_loss) < 1e-3
+
+
+class TestHSDPTrainer:
+    def _manager(self, quorum_results: List) -> Manager:
+        client = StubClient()
+        client.quorum_results.extend(quorum_results)
+        return Manager(
+            comm=DummyCommunicator(),
+            load_state_dict=None,
+            state_dict=None,
+            min_replica_size=1,
+            checkpoint_transport=MemoryTransport(),
+            _manager_client=client,
+            rank=0,
+            world_size=1,
+        )
+
+    def test_ft_train_steps(self) -> None:
+        config = llama_debug()
+        model = Llama(config)
+        mesh = make_mesh(dp=2, fsdp=2, tp=2)
+        manager = self._manager([_quorum_result() for _ in range(3)])
+        trainer = HSDPTrainer(
+            model, optax.adam(1e-3), mesh, manager, key=jax.random.PRNGKey(0)
+        )
+        batch_sh = fsdp_shardings(model, mesh)[1]
+        batch = tuple(
+            jax.device_put(b, sh)
+            for b, sh in zip(_batch(config, batch=4), batch_sh)
+        )
+        losses = []
+        for _ in range(3):
+            loss, committed = trainer.train_step(batch)
+            assert committed
+            losses.append(loss)
+        assert losses[-1] < losses[0]
+
+    def test_healing_restores_sharded_layout(self) -> None:
+        """A healed (host numpy) checkpoint must land back in HSDP layout."""
+        config = llama_debug()
+        model = Llama(config)
+        mesh = make_mesh(dp=2, fsdp=2, tp=2)
+        manager = self._manager([_quorum_result()])
+        trainer = HSDPTrainer(
+            model, optax.adam(1e-3), mesh, manager, key=jax.random.PRNGKey(0)
+        )
+        # simulate a healed state: host-side numpy pytree with new values
+        healed = jax.tree_util.tree_map(
+            lambda leaf: np.asarray(leaf) * 0 + 1.5
+            if np.asarray(leaf).dtype.kind == "f"
+            else np.asarray(leaf),
+            trainer._save_state(),
+        )
+        trainer._load_state(healed)
+        wq = trainer.holder["params"]["layers"]["wq"]
+        assert wq.sharding.spec == P(None, "fsdp", "tp")
+        np.testing.assert_allclose(np.asarray(wq)[0, 0, :3], [1.5, 1.5, 1.5])

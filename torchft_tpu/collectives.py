@@ -142,8 +142,20 @@ def allreduce_quantized(
     single = isinstance(buffers, np.ndarray)
     arrays: List[np.ndarray] = [buffers] if single else list(buffers)
 
-    if comm.size() == 1:
-        return DummyWork(arrays[0] if single else arrays)
+    if comm.size() == 1 or getattr(comm, "is_passthrough", False):
+        # single member (or a passthrough test double): the sum is our own
+        # contribution; round-trip through int8 so quantization error stays
+        # observable in tests
+        out = []
+        for a in arrays:
+            flat = np.asarray(a, dtype=np.float32).reshape(-1)
+            q, s = quantize_int8_rowwise(flat, row_size)
+            out.append(
+                dequantize_int8_rowwise(q, s, flat.size, np.float32)
+                .reshape(a.shape)
+                .astype(a.dtype, copy=False)
+            )
+        return DummyWork(out[0] if single else out)
 
     fut: Future = Future()
 
@@ -172,8 +184,9 @@ def reduce_scatter_quantized(
     flat = np.concatenate(
         [np.asarray(a, dtype=np.float32).reshape(-1) for a in arrays]
     )
-    if comm.size() == 1:
-        return DummyWork(flat)
+    if comm.size() == 1 or getattr(comm, "is_passthrough", False):
+        q, s = quantize_int8_rowwise(flat, row_size)
+        return DummyWork(dequantize_int8_rowwise(q, s, flat.size, np.float32))
 
     fut: Future = Future()
 

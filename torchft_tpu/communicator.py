@@ -872,7 +872,13 @@ def _broadcast_sync(ctx: _CommCtx, arrays: List[np.ndarray], root: int) -> List[
 
 class DummyCommunicator(Communicator):
     """World-size-1 no-op communicator (``process_group.py:1005-1134``):
-    returns inputs unchanged; soaks up wrapper init in tests."""
+    returns inputs unchanged; soaks up wrapper init in tests.
+
+    ``is_passthrough`` marks the "collectives return my own contribution"
+    fiction so shard-structured pipelines (quantized allreduce) can take an
+    equivalent local path instead of mis-assembling shards."""
+
+    is_passthrough = True
 
     def __init__(self, rank: int = 0, world_size: int = 1) -> None:
         self._rank = rank
@@ -897,9 +903,8 @@ class DummyCommunicator(Communicator):
         return DummyWork(b"")
 
     def alltoall(self, chunks, tag: int = 0) -> Work:
-        # passthrough semantics at the configured world size, matching the
-        # allreduce passthrough: every "peer's" contribution is our own
-        return DummyWork(list(chunks))
+        # mirror-world fiction: every peer sends us what we'd send ourselves
+        return DummyWork([chunks[self._rank]] * self._world_size)
 
     def allgather(self, data, tag: int = 0) -> Work:
         return DummyWork([data] * self._world_size)

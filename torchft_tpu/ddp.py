@@ -17,7 +17,11 @@ import jax
 import numpy as np
 
 from torchft_tpu.manager import Manager
-from torchft_tpu.work import Work
+from torchft_tpu.work import DummyWork, Work
+
+
+def allreduce_pytree_result(tree: Any) -> Work:
+    return DummyWork(tree)
 
 
 def _to_host(leaf: Any) -> np.ndarray:
@@ -33,6 +37,13 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
     original sharding).  Error swallowing and participation zeroing happen
     inside ``manager.allreduce``.
     """
+    if manager.errored():
+        return allreduce_pytree_result(tree)
+    if manager.allreduce_is_identity():
+        # single-member quorum: averaging is the identity; skip the
+        # device→host→device round trip entirely
+        return allreduce_pytree_result(tree)
+
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     original = list(leaves)
 

@@ -53,20 +53,27 @@ def partition_leaves(
     """Split the flattened leaves of ``params`` into ``num_fragments``
     contiguous groups of roughly equal byte size."""
     leaves = jax.tree_util.tree_leaves(params)
+    if len(leaves) < num_fragments:
+        raise ValueError(
+            f"cannot split {len(leaves)} leaves into {num_fragments} fragments"
+        )
     sizes = [int(np.asarray(leaf).nbytes) for leaf in leaves]
     total = sum(sizes)
     target = total / max(num_fragments, 1)
     groups: List[List[int]] = [[] for _ in range(num_fragments)]
     acc, g = 0.0, 0
     for i, size in enumerate(sizes):
-        if g < num_fragments - 1 and acc >= target * (g + 1):
-            g += 1
         groups[g].append(i)
         acc += size
-    if any(not group for group in groups):
-        raise ValueError(
-            f"cannot split {len(leaves)} leaves into {num_fragments} fragments"
-        )
+        # advance AFTER placing, based on accumulated bytes including this
+        # leaf, and never leave fewer leaves than remaining groups
+        remaining_leaves = len(leaves) - (i + 1)
+        remaining_groups = num_fragments - (g + 1)
+        if g < num_fragments - 1 and (
+            acc >= target * (g + 1) or remaining_leaves <= remaining_groups
+        ):
+            g += 1
+    assert all(groups), "internal error: empty fragment"
     return groups
 
 

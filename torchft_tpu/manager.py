@@ -516,6 +516,19 @@ class Manager:
     # gradient averaging
     # ------------------------------------------------------------------
 
+    def allreduce_is_identity(self) -> bool:
+        """True when the replica-dim average is mathematically the identity
+        (single-member communicator, this replica fully participating) —
+        callers may then skip device↔host gradient movement entirely, the
+        analog of a world-size-1 NCCL allreduce being free."""
+        self.wait_quorum()
+        return (
+            self._comm.size() <= 1
+            and self.num_participants() == 1
+            and self.is_participating()
+            and self._errored is None
+        )
+
     def allreduce(
         self,
         data: Union[np.ndarray, List[np.ndarray]],

@@ -1,0 +1,274 @@
+"""Llama-3-family transformer, TPU-first.
+
+The flagship model for torchft_tpu's fault-tolerant training (the reference
+trains Llama 3 8B/70B through torchtitan HSDP, ``README.md:62-69``; here the
+model is in-repo because the framework is standalone).
+
+Design choices for the TPU/XLA compilation model:
+
+- **Pure functional**: params are a pytree dict; ``apply`` is a pure
+  function — jit/pjit/shard_map compose without a module system.
+- **Stacked layers + ``lax.scan``**: per-layer weights carry a leading
+  ``n_layers`` dim and the decoder runs as one scanned block, so compile
+  time is O(1) in depth and XLA pipelines the layer loop.
+- **bf16 matmuls on the MXU**: params and activations default to bfloat16
+  with fp32 RMSNorm statistics and fp32 logits for the loss.
+- **Sharding as data**: :func:`param_specs` returns a PartitionSpec pytree
+  matching ``init`` — megatron TP on the head/ffn dims, FSDP on the
+  complementary dim, so HSDP = shard_pytree(params, param_specs(...), mesh).
+- **Sequence parallelism**: with ``sp > 1`` attention switches to ring
+  attention (``torchft_tpu.parallel.ring_attention``) over the ``sp`` axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_hidden: int = 14_336
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16
+    # sequence parallelism: ring attention over this mesh axis when set
+    sp_axis: Optional[str] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def llama3_8b() -> LlamaConfig:
+    return LlamaConfig()
+
+
+def llama3_70b() -> LlamaConfig:
+    return LlamaConfig(
+        dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, ffn_hidden=28_672
+    )
+
+
+def llama_debug(sp_axis: Optional[str] = None) -> LlamaConfig:
+    """Tiny config for tests/dryruns."""
+    return LlamaConfig(
+        vocab_size=512,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_hidden=128,
+        max_seq_len=256,
+        dtype=jnp.float32,
+        sp_axis=sp_axis,
+    )
+
+
+class Llama:
+    def __init__(self, config: LlamaConfig, mesh: Optional[Any] = None) -> None:
+        """``mesh`` is required when ``config.sp_axis`` is set: the ring
+        attention shard_map needs the concrete mesh object."""
+        self.config = config
+        self.mesh = mesh
+
+    # ------------------------------------------------------------------
+    # params
+    # ------------------------------------------------------------------
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        cfg = self.config
+        k_embed, k_layers, k_out = jax.random.split(key, 3)
+
+        def _norm(k, shape, fan_in):
+            return (
+                jax.random.normal(k, shape, dtype=jnp.float32) / np.sqrt(fan_in)
+            ).astype(cfg.dtype)
+
+        hd = cfg.head_dim
+        L = cfg.n_layers
+        keys = jax.random.split(k_layers, 7)
+        layers = {
+            "wq": _norm(keys[0], (L, cfg.dim, cfg.n_heads * hd), cfg.dim),
+            "wk": _norm(keys[1], (L, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+            "wv": _norm(keys[2], (L, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+            "wo": _norm(keys[3], (L, cfg.n_heads * hd, cfg.dim), cfg.n_heads * hd),
+            "w_gate": _norm(keys[4], (L, cfg.dim, cfg.ffn_hidden), cfg.dim),
+            "w_up": _norm(keys[5], (L, cfg.dim, cfg.ffn_hidden), cfg.dim),
+            "w_down": _norm(keys[6], (L, cfg.ffn_hidden, cfg.dim), cfg.ffn_hidden),
+            "attn_norm": jnp.ones((L, cfg.dim), dtype=jnp.float32),
+            "mlp_norm": jnp.ones((L, cfg.dim), dtype=jnp.float32),
+        }
+        return {
+            "embed": _norm(k_embed, (cfg.vocab_size, cfg.dim), cfg.dim),
+            "layers": layers,
+            "final_norm": jnp.ones(cfg.dim, dtype=jnp.float32),
+            "lm_head": _norm(k_out, (cfg.dim, cfg.vocab_size), cfg.dim),
+        }
+
+    def param_specs(self) -> Dict[str, Any]:
+        """PartitionSpecs matching :meth:`init`.
+
+        Megatron layout: column-parallel (out dim on ``tp``) for wq/wk/wv and
+        gate/up, row-parallel (in dim on ``tp``) for wo/w_down; ``fsdp``
+        shards the complementary dim.  Embeddings shard vocab on ``tp``.
+        Layer-stacked arrays keep the leading layer dim replicated.
+        """
+        return {
+            "embed": P("tp", "fsdp"),
+            "layers": {
+                "wq": P(None, "fsdp", "tp"),
+                "wk": P(None, "fsdp", "tp"),
+                "wv": P(None, "fsdp", "tp"),
+                "wo": P(None, "tp", "fsdp"),
+                "w_gate": P(None, "fsdp", "tp"),
+                "w_up": P(None, "fsdp", "tp"),
+                "w_down": P(None, "tp", "fsdp"),
+                "attn_norm": P(None, None),
+                "mlp_norm": P(None, None),
+            },
+            "final_norm": P(None),
+            "lm_head": P("fsdp", "tp"),
+        }
+
+    def batch_specs(self) -> Tuple[Any, Any]:
+        """(tokens, targets) PartitionSpecs: batch over dp, sequence over sp."""
+        spec = P("dp", "sp") if self.config.sp_axis else P("dp", None)
+        return spec, spec
+
+    # ------------------------------------------------------------------
+    # forward
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+        x32 = x.astype(jnp.float32)
+        rms = jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+        return ((x32 / rms) * weight).astype(x.dtype)
+
+    def _rope(self, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        half = cfg.head_dim // 2
+        freqs = 1.0 / (
+            cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+        )
+        angles = positions[:, :, None].astype(jnp.float32) * freqs  # [B,S,half]
+        return jnp.cos(angles), jnp.sin(angles)
+
+    @staticmethod
+    def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+        # x: [B, S, H, D]; rotate pairs (x1, x2) per RoPE
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+        return jnp.concatenate(
+            [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+        ).astype(x.dtype)
+
+    def _attention(
+        self,
+        q: jax.Array,
+        k: jax.Array,
+        v: jax.Array,
+        positions: jax.Array,
+    ) -> jax.Array:
+        """Causal GQA attention. q: [B,S,H,D], k/v: [B,S,KV,D]."""
+        cfg = self.config
+        groups = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+
+        if cfg.sp_axis is not None:
+            from torchft_tpu.parallel.ring_attention import ring_attention_sharded
+
+            assert self.mesh is not None, "sp requires a mesh on the model"
+            return ring_attention_sharded(
+                q, k, v, mesh=self.mesh, sp_axis=cfg.sp_axis
+            )
+
+        scale = 1.0 / np.sqrt(cfg.head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        seq = q.shape[1]
+        causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    def _layer(
+        self, x: jax.Array, layer_params: Dict[str, jax.Array], rope, positions
+    ) -> jax.Array:
+        cfg = self.config
+        cos, sin = rope
+        B, S, _ = x.shape
+        hd = cfg.head_dim
+
+        h = self._rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
+        q = (h @ layer_params["wq"]).reshape(B, S, cfg.n_heads, hd)
+        k = (h @ layer_params["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+        v = (h @ layer_params["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+        q = self._apply_rope(q, cos, sin)
+        k = self._apply_rope(k, cos, sin)
+        attn = self._attention(q, k, v, positions)
+        x = x + attn.reshape(B, S, cfg.n_heads * hd) @ layer_params["wo"]
+
+        h = self._rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ layer_params["w_gate"])
+        up = h @ layer_params["w_up"]
+        x = x + (gate * up) @ layer_params["w_down"]
+        return x
+
+    def apply(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+        """tokens [B, S] → logits [B, S, vocab] (fp32)."""
+        cfg = self.config
+        B, S = tokens.shape
+        x = params["embed"][tokens].astype(cfg.dtype)
+
+        # Shapes under jit are GLOBAL even when the sequence dim is sharded
+        # over sp — only the ring-attention shard_map body sees local blocks.
+        positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        rope = self._rope(positions)
+
+        def scan_body(carry, layer_params):
+            return self._layer(carry, layer_params, rope, positions), None
+
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        x = self._rms_norm(x, params["final_norm"], cfg.norm_eps)
+        return (x @ params["lm_head"]).astype(jnp.float32)
+
+    def loss(
+        self, params: Dict[str, Any], batch: Tuple[jax.Array, jax.Array]
+    ) -> jax.Array:
+        """Mean next-token cross-entropy; batch = (tokens, targets)."""
+        tokens, targets = batch
+        logits = self.apply(params, tokens)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    def num_params(self) -> int:
+        cfg = self.config
+        hd = cfg.head_dim
+        per_layer = (
+            cfg.dim * cfg.n_heads * hd  # wq
+            + 2 * cfg.dim * cfg.n_kv_heads * hd  # wk, wv
+            + cfg.n_heads * hd * cfg.dim  # wo
+            + 3 * cfg.dim * cfg.ffn_hidden  # gate, up, down
+            + 2 * cfg.dim  # norms
+        )
+        return (
+            cfg.vocab_size * cfg.dim * 2  # embed + lm_head
+            + cfg.n_layers * per_layer
+            + cfg.dim
+        )

@@ -1,0 +1,28 @@
+"""Intra-replica parallelism: mesh building, sharding rules, HSDP
+composition, and sequence-parallel ring attention.
+
+The replica (outer-DP) dimension is handled by the Manager/communicator and
+stays OFF these meshes (SURVEY.md §7): everything here runs inside compiled
+XLA programs over ICI.
+"""
+
+_LAZY = {
+    "make_mesh": ("torchft_tpu.parallel.mesh", "make_mesh"),
+    "MeshAxes": ("torchft_tpu.parallel.mesh", "MeshAxes"),
+    "shard_pytree": ("torchft_tpu.parallel.mesh", "shard_pytree"),
+    "ring_attention": ("torchft_tpu.parallel.ring_attention", "ring_attention"),
+    "fsdp_shardings": ("torchft_tpu.parallel.hsdp", "fsdp_shardings"),
+    "hsdp_train_step": ("torchft_tpu.parallel.hsdp", "hsdp_train_step"),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)

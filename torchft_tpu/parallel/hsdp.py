@@ -1,0 +1,160 @@
+"""HSDP composition: FSDP/TP over ICI inside a replica × FT-DDP over DCN.
+
+The reference composes FSDP2 ``fully_shard`` inside each replica with a
+torchft allreduce hook on the replica dimension
+(``fsdp_test.py:55-73``, torchtitan per ``README.md:62-69``).  The jax-native
+equivalent:
+
+- **inner**: parameters/optimizer state sharded with ``NamedSharding`` over
+  the replica group's mesh axes (``fsdp``/``tp``); XLA SPMD inserts the
+  all-gathers/reduce-scatters over ICI.
+- **outer**: after the compiled grad step, the Manager averages gradients
+  across replica groups host-side over DCN — the replica count never enters
+  the compiled program, so elastic membership can't trigger recompilation
+  (SURVEY.md §7 hard part 1).
+
+Single-controller note: each replica group is one process driving its slice;
+``np.asarray`` on a sharded gradient assembles the process's addressable
+shards.  On multi-host slices each host averages only its addressable
+shards — same math, sharded bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchft_tpu.ddp import ft_allreduce
+from torchft_tpu.manager import Manager
+
+
+def fsdp_shardings(
+    model: Any, mesh: Mesh
+) -> Tuple[Any, Any]:
+    """(param shardings, batch shardings) for a model exposing
+    ``param_specs()`` / ``batch_specs()`` (e.g. :class:`models.llama.Llama`)."""
+    param_specs = model.param_specs()
+    params_sh = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    tok_spec, tgt_spec = model.batch_specs()
+    batch_sh = (NamedSharding(mesh, tok_spec), NamedSharding(mesh, tgt_spec))
+    return params_sh, batch_sh
+
+
+def shard_init(model: Any, key: jax.Array, mesh: Mesh) -> Any:
+    """Initialize params directly into their HSDP layout (jit + out_shardings
+    so big models never materialize unsharded)."""
+    params_sh, _ = fsdp_shardings(model, mesh)
+    with mesh:
+        return jax.jit(model.init, out_shardings=params_sh)(key)
+
+
+def make_grad_step(
+    model: Any, mesh: Mesh
+) -> Callable[[Any, Any], Tuple[jax.Array, Any]]:
+    """Compile ``(params, batch) → (loss, grads)`` with grads sharded like
+    params (the FSDP reduce-scatter happens inside via XLA SPMD)."""
+    params_sh, batch_sh = fsdp_shardings(model, mesh)
+
+    def _step(params: Any, batch: Any) -> Tuple[jax.Array, Any]:
+        return jax.value_and_grad(model.loss)(params, batch)
+
+    with mesh:
+        return jax.jit(
+            _step,
+            in_shardings=(params_sh, batch_sh),
+            out_shardings=(NamedSharding(mesh, P()), params_sh),
+        )
+
+
+def make_update_step(
+    model: Any, tx: Any, mesh: Mesh
+) -> Callable[[Any, Any, Any], Tuple[Any, Any]]:
+    """Compile the optax update with params/grads/opt_state in HSDP layout."""
+    import optax
+
+    params_sh, _ = fsdp_shardings(model, mesh)
+
+    def _update(params: Any, opt_state: Any, grads: Any) -> Tuple[Any, Any]:
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    with mesh:
+        return jax.jit(_update, donate_argnums=(0, 1))
+
+
+class HSDPTrainer:
+    """Fault-tolerant HSDP training driver (BASELINE config 3).
+
+    Per step: quorum (async, overlapped) → compiled grad step (FSDP/TP over
+    ICI) → replica-dim gradient average (Manager over DCN) → commit-gated
+    compiled update.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        tx: Any,
+        mesh: Mesh,
+        manager: Manager,
+        key: Optional[jax.Array] = None,
+        params: Optional[Any] = None,
+    ) -> None:
+        self.model = model
+        self.tx = tx
+        self.mesh = mesh
+        self.manager = manager
+        if params is None:
+            assert key is not None, "need key or params"
+            params = shard_init(model, key, mesh)
+        with mesh:
+            opt_state = jax.jit(tx.init)(params)
+        self.holder: Dict[str, Any] = {"params": params, "opt_state": opt_state}
+        self._grad_step = make_grad_step(model, mesh)
+        self._update_step = make_update_step(model, tx, mesh)
+
+        manager.register_state_dict_fn(
+            "hsdp", self._load_state, self._save_state
+        )
+
+    def _save_state(self) -> Dict[str, Any]:
+        return dict(self.holder)
+
+    def _load_state(self, state: Dict[str, Any]) -> None:
+        # restore placement: healing delivers host arrays; put them back into
+        # the HSDP layout of the existing values
+        params_like = self.holder["params"]
+        self.holder["params"] = jax.tree_util.tree_map(
+            lambda new, old: jax.device_put(
+                new, old.sharding if isinstance(old, jax.Array) else None
+            ),
+            state["params"],
+            params_like,
+        )
+        self.holder["opt_state"] = jax.tree_util.tree_map(
+            lambda new, old: jax.device_put(
+                new, old.sharding if isinstance(old, jax.Array) else None
+            ),
+            state["opt_state"],
+            self.holder["opt_state"],
+        )
+
+    def train_step(self, batch: Any) -> Tuple[float, bool]:
+        """One fault-tolerant step; returns (loss, committed)."""
+        self.manager.start_quorum()
+        loss, grads = self._grad_step(self.holder["params"], batch)
+        grads = ft_allreduce(self.manager, grads)
+        committed = self.manager.should_commit()
+        if committed:
+            params, opt_state = self._update_step(
+                self.holder["params"], self.holder["opt_state"], grads
+            )
+            self.holder["params"] = params
+            self.holder["opt_state"] = opt_state
+        return float(loss), committed

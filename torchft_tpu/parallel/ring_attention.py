@@ -1,0 +1,130 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Net-new relative to the reference (torchft has no sequence parallelism,
+SURVEY.md §5.7) but first-class here: long-context training must scale past
+one chip's HBM, and the TPU-native way is blockwise causal attention with
+K/V blocks rotating around the ``sp`` ring via ``lax.ppermute`` over ICI
+(the Ring Attention construction, with flash-style online-softmax
+accumulation so memory stays O(block)).
+
+Layout: Q/K/V are sharded on the sequence dim over ``sp`` (and heads over
+``tp``); each of the ``n`` ring steps overlaps one neighbor exchange with
+one block of attention math.  Causality across blocks falls out of global
+block indices: a K/V block from a later position contributes nothing, the
+diagonal block is masked triangularly, earlier blocks attend fully.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8 top-level export, fall back to experimental
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """shard_map body: q/k/v are LOCAL blocks [B, S_blk, H, D].
+
+    Online softmax across ring steps (numerically stable streaming
+    accumulation); one ppermute per step rotates the K/V block to the next
+    neighbor so every block visits every rank.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+
+    q32 = q.astype(jnp.float32)
+    # accumulators: running output (unnormalized), row max, denominator
+    o = jnp.zeros((B, S, H, D), dtype=jnp.float32)
+    m = jnp.full((B, S, H), -jnp.inf, dtype=jnp.float32)
+    l = jnp.zeros((B, S, H), dtype=jnp.float32)
+
+    # local positions within a block (global offset falls out of block idx)
+    row_pos = jnp.arange(S)
+    col_pos = jnp.arange(S)
+
+    def step(carry, step_idx):
+        o, m, l, k_blk, v_blk = carry
+        src_idx = (my_idx - step_idx) % n  # whose block we hold this step
+
+        scores = (
+            jnp.einsum("bqhd,bkhd->bqhk", q32, k_blk.astype(jnp.float32))
+            * scale
+        )
+        # causal mask from global block indices:
+        #   src block earlier   → attend fully
+        #   same block          → lower triangle
+        #   src block later     → nothing
+        tri = row_pos[:, None] >= col_pos[None, :]
+        allow = jnp.where(
+            src_idx < my_idx,
+            jnp.ones((S, S), dtype=bool),
+            jnp.where(src_idx == my_idx, tri, jnp.zeros((S, S), dtype=bool)),
+        )
+        scores = jnp.where(allow[None, :, None, :], scores, -1e30)
+
+        blk_max = jnp.max(scores, axis=-1)  # [B,S,H]
+        m_new = jnp.maximum(m, blk_max)
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])  # [B,S,H,K]
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        o_new = o * correction[..., None] + jnp.einsum(
+            "bqhk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+        )
+
+        # rotate K/V to the next rank (ring over ICI)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o, m, l, k, v), jnp.arange(n)
+    )
+    # rows that attended to nothing (can't happen causally, but guard /0)
+    denom = jnp.where(l > 0, l, 1.0)
+    return (o / denom[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+) -> jax.Array:
+    """Ring attention entry point for jit-traced (global-shape) arrays.
+
+    q/k/v: [B, S, H, D] with S sharded over ``sp_axis``, B over ``dp``, and
+    heads over ``tp``; returns attention output with the same layout.
+    """
+    spec = P("dp", sp_axis, "tp", None)
+    fn = _shard_map(
+        partial(_ring_attention_local, axis_name=sp_axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str = "sp"
+) -> jax.Array:
+    """Raw collective form for callers already inside shard_map/pmap."""
+    return _ring_attention_local(q, k, v, axis_name)
