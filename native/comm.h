@@ -1,0 +1,650 @@
+// Host-side data-plane communicator — C++ twin of the Python
+// TCPCommunicator mesh tier (torchft_tpu/communicator.py), built for DCN
+// throughput: poll()-driven duplex IO on non-blocking sockets, large socket
+// buffers, -O3 vectorized reduction loops, ring allreduce
+// (reduce-scatter + allgather), alltoall/allgather, broadcast, send/recv.
+//
+// All ops are synchronous at this level and abortable: abort() flips a flag
+// and shuts the sockets down, unblocking any op mid-IO (the userspace
+// timeout/abort doctrine, SURVEY.md §5.8.5).  The Python wrapper
+// (torchft_tpu/native.py CppCommunicator) serializes ops on an op thread
+// and layers Work/timeout semantics on top.
+
+#pragma once
+
+#include <fcntl.h>
+#include <poll.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstring>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "store.h"
+#include "wire.h"
+
+namespace tpuft {
+
+enum DType : int32_t {
+  DT_F32 = 0,
+  DT_F64 = 1,
+  DT_I32 = 2,
+  DT_I64 = 3,
+  DT_BF16 = 4,
+  DT_U8 = 5,
+  DT_I8 = 6,
+};
+
+enum RedOp : int32_t { OP_SUM = 0, OP_MAX = 1, OP_MIN = 2 };
+
+inline size_t dtype_size(DType dt) {
+  switch (dt) {
+    case DT_F64:
+    case DT_I64:
+      return 8;
+    case DT_F32:
+    case DT_I32:
+      return 4;
+    case DT_BF16:
+      return 2;
+    default:
+      return 1;
+  }
+}
+
+inline float bf16_to_f32(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even
+  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+template <typename T>
+inline void reduce_typed(T* acc, const T* in, size_t n, RedOp op) {
+  switch (op) {
+    case OP_SUM:
+      for (size_t i = 0; i < n; ++i) acc[i] += in[i];
+      break;
+    case OP_MAX:
+      for (size_t i = 0; i < n; ++i) acc[i] = acc[i] > in[i] ? acc[i] : in[i];
+      break;
+    case OP_MIN:
+      for (size_t i = 0; i < n; ++i) acc[i] = acc[i] < in[i] ? acc[i] : in[i];
+      break;
+  }
+}
+
+inline void reduce_buffer(void* acc, const void* in, size_t nbytes, DType dt,
+                          RedOp op) {
+  switch (dt) {
+    case DT_F32:
+      reduce_typed(static_cast<float*>(acc), static_cast<const float*>(in),
+                   nbytes / 4, op);
+      break;
+    case DT_F64:
+      reduce_typed(static_cast<double*>(acc), static_cast<const double*>(in),
+                   nbytes / 8, op);
+      break;
+    case DT_I32:
+      reduce_typed(static_cast<int32_t*>(acc), static_cast<const int32_t*>(in),
+                   nbytes / 4, op);
+      break;
+    case DT_I64:
+      reduce_typed(static_cast<int64_t*>(acc), static_cast<const int64_t*>(in),
+                   nbytes / 8, op);
+      break;
+    case DT_I8:
+      reduce_typed(static_cast<int8_t*>(acc), static_cast<const int8_t*>(in),
+                   nbytes, op);
+      break;
+    case DT_U8:
+      reduce_typed(static_cast<uint8_t*>(acc), static_cast<const uint8_t*>(in),
+                   nbytes, op);
+      break;
+    case DT_BF16: {
+      auto* a = static_cast<uint16_t*>(acc);
+      auto* b = static_cast<const uint16_t*>(in);
+      size_t n = nbytes / 2;
+      for (size_t i = 0; i < n; ++i) {
+        float fa = bf16_to_f32(a[i]);
+        float fb = bf16_to_f32(b[i]);
+        float out = op == OP_SUM   ? fa + fb
+                    : op == OP_MAX ? (fa > fb ? fa : fb)
+                                   : (fa < fb ? fa : fb);
+        a[i] = f32_to_bf16(out);
+      }
+      break;
+    }
+  }
+}
+
+struct CommError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+class Communicator {
+ public:
+  explicit Communicator(double timeout_s) : timeout_s_(timeout_s) {}
+
+  ~Communicator() {
+    abort();
+    close_peers();
+  }
+
+  // Rendezvous over the store: publish our listener under "{prefix}/{rank}";
+  // for each pair (i, j) with i < j, j dials i.  store_prefixed_addr is
+  // "host:port/prefix/..." exactly like the Python tier.
+  void configure(const std::string& store_prefixed_addr, int64_t rank,
+                 int64_t world_size) {
+    abort();  // supersede any previous epoch
+    {
+      // old fds go to the graveyard (closed at destruction): an op thread
+      // may still reference them, and closing now could recycle fd numbers
+      std::lock_guard<std::mutex> lock(state_mu_);
+      for (auto& [peer, fd] : peers_) graveyard_.push_back(fd);
+      peers_.clear();
+    }
+    aborted_ = false;
+    rank_ = rank;
+    world_size_ = world_size;
+    if (world_size <= 1) return;
+
+    auto slash = store_prefixed_addr.find('/');
+    std::string store_addr = store_prefixed_addr.substr(0, slash);
+    std::string prefix = slash == std::string::npos
+                             ? std::string("root")
+                             : store_prefixed_addr.substr(slash + 1);
+
+    StoreClient store(store_addr, timeout_s_);
+
+    int port = 0;
+    int listen_fd = listen_on("0.0.0.0:0", &port);
+    char host[256];
+    ::gethostname(host, sizeof(host));
+    std::string host_str(host);
+    {
+      // prefer a dialable address even on hosts with odd hostname setup
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      if (::getaddrinfo(host_str.c_str(), nullptr, &hints, &res) != 0 || !res)
+        host_str = "127.0.0.1";
+      if (res) ::freeaddrinfo(res);
+    }
+    store.set(prefix + "/" + std::to_string(rank_),
+              host_str + ":" + std::to_string(port));
+
+    // accept from higher ranks on a helper thread while dialing lower ranks
+    int expected_inbound = static_cast<int>(world_size - rank - 1);
+    std::map<int64_t, int> inbound;
+    std::string accept_err;
+    // bound the whole accept phase: a dead higher-rank peer must not wedge
+    // configure() (the Python twin sets listener.settimeout(timeout_s))
+    set_recv_timeout(listen_fd, timeout_s_);
+    std::thread acceptor([&] {
+      try {
+        for (int i = 0; i < expected_inbound; ++i) {
+          int conn = ::accept(listen_fd, nullptr, nullptr);
+          if (conn < 0)
+            throw CommError("rendezvous accept timed out or failed");
+          configure_socket(conn);
+          set_recv_timeout(conn, timeout_s_);
+          uint64_t peer_rank;
+          recv_exact(conn, &peer_rank, 8);
+          inbound[static_cast<int64_t>(peer_rank)] = conn;
+        }
+      } catch (const std::exception& e) {
+        accept_err = e.what();
+      }
+    });
+
+    std::map<int64_t, int> fresh;
+    try {
+      for (int64_t peer = 0; peer < rank_; ++peer) {
+        std::string addr =
+            store.get(prefix + "/" + std::to_string(peer), timeout_s_);
+        int fd = dial(addr, timeout_s_);
+        uint64_t my_rank = static_cast<uint64_t>(rank_);
+        send_all(fd, &my_rank, 8);
+        fresh[peer] = fd;
+      }
+      acceptor.join();
+      if (!accept_err.empty())
+        throw CommError("rendezvous accept failed: " + accept_err);
+      for (auto& [peer, fd] : inbound) fresh[peer] = fd;
+    } catch (...) {
+      if (acceptor.joinable()) acceptor.join();
+      for (auto& [peer, fd] : fresh) ::close(fd);
+      ::close(listen_fd);
+      throw;
+    }
+    ::close(listen_fd);
+
+    for (auto& [peer, fd] : fresh) {
+      int buf = 8 * 1024 * 1024;  // deep kernel buffers for throughput
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // poll()-driven duplex loops require non-blocking IO
+      int fl = ::fcntl(fd, F_GETFL, 0);
+      ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    }
+    {
+      std::lock_guard<std::mutex> lock(state_mu_);
+      peers_ = std::move(fresh);
+    }
+  }
+
+  void abort() {
+    // Shut sockets down (don't close): an op thread may be mid-poll on these
+    // fds; shutdown unblocks its IO with errors while keeping fd numbers
+    // valid.  close happens at destruction.
+    aborted_ = true;
+    std::lock_guard<std::mutex> lock(state_mu_);
+    for (auto& [peer, fd] : peers_) ::shutdown(fd, SHUT_RDWR);
+  }
+
+  void close_peers() {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    for (auto& [peer, fd] : peers_) ::close(fd);
+    peers_.clear();
+    for (int fd : graveyard_) ::close(fd);
+    graveyard_.clear();
+  }
+
+  std::map<int64_t, int> peers_snapshot() const {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    return peers_;
+  }
+
+  int64_t rank() const { return rank_; }
+  int64_t size() const { return world_size_; }
+  void set_timeout(double t) { timeout_s_ = t; }
+
+  // -- collectives (synchronous; caller provides an op thread) -------------
+
+  // In-place ring allreduce over a contiguous buffer.
+  void allreduce(void* data, size_t nbytes, DType dt, RedOp op) {
+    if (world_size_ <= 1) return;
+    size_t esz = dtype_size(dt);
+    size_t n = nbytes / esz;
+    int64_t ws = world_size_;
+    int64_t right = (rank_ + 1) % ws;
+    int64_t left = (rank_ - 1 + ws) % ws;
+    auto deadline = deadline_in(timeout_s_);
+
+    // element bounds per chunk
+    std::vector<size_t> bounds(ws + 1, 0);
+    size_t base = n / ws, extra = n % ws;
+    for (int64_t i = 0; i < ws; ++i)
+      bounds[i + 1] = bounds[i] + base + (static_cast<size_t>(i) < extra ? 1 : 0);
+
+    uint8_t* bytes = static_cast<uint8_t*>(data);
+    std::vector<uint8_t> scratch((base + (extra ? 1 : 0)) * esz);
+
+    auto chunk_ptr = [&](int64_t i) {
+      i = ((i % ws) + ws) % ws;
+      return bytes + bounds[i] * esz;
+    };
+    auto chunk_bytes = [&](int64_t i) {
+      i = ((i % ws) + ws) % ws;
+      return (bounds[i + 1] - bounds[i]) * esz;
+    };
+
+    for (int64_t step = 0; step < ws - 1; ++step) {
+      int64_t send_idx = rank_ - step;
+      int64_t recv_idx = rank_ - step - 1;
+      exchange(right, 1000 + step, chunk_ptr(send_idx), chunk_bytes(send_idx),
+               left, 1000 + step, scratch.data(), chunk_bytes(recv_idx),
+               deadline);
+      reduce_buffer(chunk_ptr(recv_idx), scratch.data(), chunk_bytes(recv_idx),
+                    dt, op);
+    }
+    for (int64_t step = 0; step < ws - 1; ++step) {
+      int64_t send_idx = rank_ + 1 - step;
+      int64_t recv_idx = rank_ - step;
+      exchange(right, 2000 + step, chunk_ptr(send_idx), chunk_bytes(send_idx),
+               left, 2000 + step, chunk_ptr(recv_idx), chunk_bytes(recv_idx),
+               deadline);
+    }
+  }
+
+  void broadcast(void* data, size_t nbytes, int64_t root) {
+    if (world_size_ <= 1) return;
+    auto deadline = deadline_in(timeout_s_);
+    if (rank_ == root) {
+      // concurrent fan-out to every peer (send-only multi_exchange)
+      const uint8_t* src = static_cast<const uint8_t*>(data);
+      multi_exchange(
+          peers_snapshot(),
+          [&](int64_t) { return std::make_pair(src, nbytes); },
+          [&](int64_t) {
+            return std::make_pair(static_cast<uint8_t*>(nullptr), size_t(0));
+          },
+          3000, deadline);
+    } else {
+      exchange(-1, 0, nullptr, 0, root, 3000, data, nbytes, deadline);
+    }
+  }
+
+  void send(const void* data, size_t nbytes, int64_t dst, uint64_t tag) {
+    auto deadline = deadline_in(timeout_s_);
+    exchange(dst, tag, const_cast<void*>(data), nbytes, -1, 0, nullptr, 0,
+             deadline);
+  }
+
+  // receiver learns the size from the frame header
+  std::vector<uint8_t> recv_dynamic(int64_t src, uint64_t tag) {
+    auto deadline = deadline_in(timeout_s_);
+    int fd = peer_fd(src);
+    uint64_t hdr[2];
+    recv_deadline(fd, hdr, 16, deadline, src);
+    if (hdr[1] != tag)
+      throw CommError("tag mismatch from rank " + std::to_string(src));
+    std::vector<uint8_t> out(hdr[0]);
+    recv_deadline(fd, out.data(), out.size(), deadline, src);
+    return out;
+  }
+
+  // symmetric alltoall of equal-size chunks; chunks laid out contiguously in
+  // `data` (ws chunks of chunk_bytes); received into `out` by source rank.
+  void alltoall(const void* data, void* out, size_t chunk_bytes, uint64_t tag) {
+    const uint8_t* in = static_cast<const uint8_t*>(data);
+    uint8_t* o = static_cast<uint8_t*>(out);
+    std::memcpy(o + rank_ * chunk_bytes, in + rank_ * chunk_bytes, chunk_bytes);
+    auto deadline = deadline_in(timeout_s_);
+    // pairwise exchange with every peer concurrently
+    multi_exchange(
+        peers_snapshot(),
+        [&](int64_t p) { return std::make_pair(in + p * chunk_bytes, chunk_bytes); },
+        [&](int64_t p) { return std::make_pair(o + p * chunk_bytes, chunk_bytes); },
+        4000 + tag, deadline);
+  }
+
+  void allgather(const void* data, void* out, size_t chunk_bytes, uint64_t tag) {
+    const uint8_t* in = static_cast<const uint8_t*>(data);
+    uint8_t* o = static_cast<uint8_t*>(out);
+    std::memcpy(o + rank_ * chunk_bytes, in, chunk_bytes);
+    auto deadline = deadline_in(timeout_s_);
+    multi_exchange(
+        peers_snapshot(),
+        [&](int64_t) { return std::make_pair(in, chunk_bytes); },
+        [&](int64_t p) { return std::make_pair(o + p * chunk_bytes, chunk_bytes); },
+        5000 + tag, deadline);
+  }
+
+  void barrier() {
+    float token = 0.0f;
+    allreduce(&token, sizeof(token), DT_F32, OP_SUM);
+  }
+
+ private:
+  using TimePoint = std::chrono::steady_clock::time_point;
+  static TimePoint now() { return std::chrono::steady_clock::now(); }
+  TimePoint deadline_in(double seconds) const {
+    return now() + std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                       std::chrono::duration<double>(seconds));
+  }
+
+  static int peer_fd_in(const std::map<int64_t, int>& peers, int64_t peer,
+                        bool aborted) {
+    auto it = peers.find(peer);
+    if (it == peers.end())
+      throw CommError("no peer " + std::to_string(peer) +
+                      (aborted ? " (communicator aborted)" : ""));
+    return it->second;
+  }
+
+  int peer_fd(int64_t peer) {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    auto it = peers_.find(peer);
+    if (it == peers_.end())
+      throw CommError("no peer " + std::to_string(peer) +
+                      (aborted_ ? " (communicator aborted)" : ""));
+    return it->second;
+  }
+
+  void check_abort() const {
+    if (aborted_) throw CommError("communicator aborted");
+  }
+
+  void recv_deadline(int fd, void* buf, size_t n, TimePoint deadline,
+                     int64_t peer) {
+    uint8_t* p = static_cast<uint8_t*>(buf);
+    while (n > 0) {
+      check_abort();
+      if (now() > deadline) throw CommError("recv timed out");
+      pollfd pfd{fd, POLLIN, 0};
+      int ready = ::poll(&pfd, 1, 100);
+      if (ready <= 0) continue;
+      ssize_t got = ::recv(fd, p, n, 0);
+      if (got == 0)
+        throw CommError("connection to rank " + std::to_string(peer) + " closed");
+      if (got < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) continue;
+        throw CommError("recv failed from rank " + std::to_string(peer));
+      }
+      p += got;
+      n -= static_cast<size_t>(got);
+    }
+  }
+
+  // duplex single-pair exchange: optionally send (dst>=0) and/or receive
+  // (src>=0) one framed payload, progressing both directions concurrently.
+  void exchange(int64_t dst, uint64_t send_tag, void* send_buf,
+                size_t send_bytes, int64_t src, uint64_t recv_tag,
+                void* recv_buf, size_t recv_bytes, TimePoint deadline) {
+    struct Dir {
+      int fd = -1;
+      uint8_t hdr[16];
+      size_t hdr_done = 0;
+      uint8_t* payload = nullptr;
+      size_t remaining = 0;
+      bool active = false;
+    };
+    Dir sd, rd;
+    if (dst >= 0) {
+      sd.fd = peer_fd(dst);
+      uint64_t h[2] = {send_bytes, send_tag};
+      std::memcpy(sd.hdr, h, 16);
+      sd.payload = static_cast<uint8_t*>(send_buf);
+      sd.remaining = send_bytes;
+      sd.active = true;
+    }
+    if (src >= 0) {
+      rd.fd = peer_fd(src);
+      rd.payload = static_cast<uint8_t*>(recv_buf);
+      rd.remaining = recv_bytes;
+      rd.active = true;
+    }
+
+    while (sd.active || rd.active) {
+      check_abort();
+      if (now() > deadline) throw CommError("exchange timed out");
+      pollfd pfds[2];
+      int n = 0;
+      int si = -1, ri = -1;
+      if (sd.active) {
+        si = n;
+        pfds[n++] = {sd.fd, POLLOUT, 0};
+      }
+      if (rd.active) {
+        ri = n;
+        pfds[n++] = {rd.fd, POLLIN, 0};
+      }
+      if (::poll(pfds, n, 100) <= 0) continue;
+
+      if (si >= 0 && (pfds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+        if (sd.hdr_done < 16) {
+          ssize_t sent = ::send(sd.fd, sd.hdr + sd.hdr_done, 16 - sd.hdr_done,
+                                MSG_NOSIGNAL);
+          if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+            throw CommError("send failed to rank " + std::to_string(dst));
+          if (sent > 0) sd.hdr_done += static_cast<size_t>(sent);
+        } else if (sd.remaining > 0) {
+          ssize_t sent = ::send(sd.fd, sd.payload, sd.remaining, MSG_NOSIGNAL);
+          if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+            throw CommError("send failed to rank " + std::to_string(dst));
+          if (sent > 0) {
+            sd.payload += sent;
+            sd.remaining -= static_cast<size_t>(sent);
+          }
+        }
+        if (sd.hdr_done == 16 && sd.remaining == 0) sd.active = false;
+      }
+
+      if (ri >= 0 && (pfds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+        if (rd.hdr_done < 16) {
+          ssize_t got = ::recv(rd.fd, rd.hdr + rd.hdr_done, 16 - rd.hdr_done, 0);
+          if (got == 0)
+            throw CommError("connection to rank " + std::to_string(src) + " closed");
+          if (got < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+            throw CommError("recv failed from rank " + std::to_string(src));
+          if (got > 0) rd.hdr_done += static_cast<size_t>(got);
+          if (rd.hdr_done == 16) {
+            uint64_t h[2];
+            std::memcpy(h, rd.hdr, 16);
+            if (h[1] != recv_tag)
+              throw CommError("tag mismatch from rank " + std::to_string(src));
+            if (h[0] != recv_bytes)
+              throw CommError("size mismatch from rank " + std::to_string(src));
+          }
+        } else if (rd.remaining > 0) {
+          ssize_t got = ::recv(rd.fd, rd.payload, rd.remaining, 0);
+          if (got == 0)
+            throw CommError("connection to rank " + std::to_string(src) + " closed");
+          if (got < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+            throw CommError("recv failed from rank " + std::to_string(src));
+          if (got > 0) {
+            rd.payload += got;
+            rd.remaining -= static_cast<size_t>(got);
+          }
+        }
+        if (rd.hdr_done == 16 && rd.remaining == 0) rd.active = false;
+      }
+    }
+  }
+
+  // all-peers concurrent exchange (alltoall/allgather)
+  template <typename SendFn, typename RecvFn>
+  void multi_exchange(const std::map<int64_t, int>& peers, SendFn send_for,
+                      RecvFn recv_for, uint64_t tag, TimePoint deadline) {
+    struct State {
+      int fd;
+      uint8_t shdr[16];
+      size_t shdr_done = 0;
+      const uint8_t* sbuf;
+      size_t sbytes;
+      uint8_t rhdr[16];
+      size_t rhdr_done = 0;
+      uint8_t* rbuf;
+      size_t rbytes;
+      bool send_done = false, recv_done = false;
+      int64_t peer;
+    };
+    std::vector<State> states;
+    for (auto& [peer, fd] : peers) {
+      State st;
+      st.fd = fd;
+      st.peer = peer;
+      auto [sb, sn] = send_for(peer);
+      auto [rb, rn] = recv_for(peer);
+      uint64_t h[2] = {sn, tag};
+      std::memcpy(st.shdr, h, 16);
+      st.sbuf = sb;
+      st.sbytes = sn;
+      st.rbuf = rb;
+      st.rbytes = rn;
+      st.recv_done = (rb == nullptr);  // send-only leg (e.g. broadcast root)
+      states.push_back(st);
+    }
+
+    bool pending = !states.empty();
+    while (pending) {
+      check_abort();
+      if (now() > deadline) throw CommError("multi_exchange timed out");
+      std::vector<pollfd> pfds;
+      for (auto& st : states) {
+        short events = 0;
+        if (!st.send_done) events |= POLLOUT;
+        if (!st.recv_done) events |= POLLIN;
+        pfds.push_back({st.fd, events, 0});
+      }
+      if (::poll(pfds.data(), pfds.size(), 100) <= 0) continue;
+
+      pending = false;
+      for (size_t i = 0; i < states.size(); ++i) {
+        auto& st = states[i];
+        if (!st.send_done && (pfds[i].revents & (POLLOUT | POLLERR))) {
+          if (st.shdr_done < 16) {
+            ssize_t sent = ::send(st.fd, st.shdr + st.shdr_done,
+                                  16 - st.shdr_done, MSG_NOSIGNAL);
+            if (sent > 0) st.shdr_done += static_cast<size_t>(sent);
+            else if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+              throw CommError("send failed to rank " + std::to_string(st.peer));
+          } else if (st.sbytes > 0) {
+            ssize_t sent = ::send(st.fd, st.sbuf, st.sbytes, MSG_NOSIGNAL);
+            if (sent > 0) {
+              st.sbuf += sent;
+              st.sbytes -= static_cast<size_t>(sent);
+            } else if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+              throw CommError("send failed to rank " + std::to_string(st.peer));
+          }
+          if (st.shdr_done == 16 && st.sbytes == 0) st.send_done = true;
+        }
+        if (!st.recv_done && (pfds[i].revents & (POLLIN | POLLERR | POLLHUP))) {
+          if (st.rhdr_done < 16) {
+            ssize_t got =
+                ::recv(st.fd, st.rhdr + st.rhdr_done, 16 - st.rhdr_done, 0);
+            if (got == 0)
+              throw CommError("connection to rank " + std::to_string(st.peer) + " closed");
+            if (got > 0) st.rhdr_done += static_cast<size_t>(got);
+            else if (got < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+              throw CommError("recv failed from rank " + std::to_string(st.peer));
+            if (st.rhdr_done == 16) {
+              uint64_t h[2];
+              std::memcpy(h, st.rhdr, 16);
+              if (h[1] != tag || h[0] != st.rbytes)
+                throw CommError("frame mismatch from rank " + std::to_string(st.peer));
+            }
+          } else if (st.rbytes > 0) {
+            ssize_t got = ::recv(st.fd, st.rbuf, st.rbytes, 0);
+            if (got == 0)
+              throw CommError("connection to rank " + std::to_string(st.peer) + " closed");
+            if (got > 0) {
+              st.rbuf += got;
+              st.rbytes -= static_cast<size_t>(got);
+            } else if (got < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+              throw CommError("recv failed from rank " + std::to_string(st.peer));
+          }
+          if (st.rhdr_done == 16 && st.rbytes == 0) st.recv_done = true;
+        }
+        if (!st.send_done || !st.recv_done) pending = true;
+      }
+    }
+  }
+
+  double timeout_s_;
+  int64_t rank_ = 0;
+  int64_t world_size_ = 1;
+  std::atomic<bool> aborted_{false};
+  // guards peers_/graveyard_ STRUCTURE only — never held across IO; ops
+  // snapshot the fds they need at entry (fds stay open until destruction,
+  // so a snapshot can never dangle)
+  mutable std::mutex state_mu_;
+  std::map<int64_t, int> peers_;
+  std::vector<int> graveyard_;
+};
+
+}  // namespace tpuft

@@ -120,6 +120,7 @@ class Manager:
         quorum_retries: int = 0,
         _manager_client: Optional[ManagerClient] = None,
         _peer_client_factory: Optional[Callable[[str], ManagerClient]] = None,
+        server_cls: Optional[type] = None,
     ) -> None:
         self.quorum_logger = logging.getLogger("torchft_quorums")
         self.commits_logger = logging.getLogger("torchft_commits")
@@ -222,7 +223,9 @@ class Manager:
             if lighthouse_addr is None:
                 lighthouse_addr = os.environ[LIGHTHOUSE_ENV]
             bind_port = port or int(os.environ.get(MANAGER_PORT_ENV, 0))
-            self._manager_server = ManagerServer(
+            # server_cls lets deployments swap in the C++ sidecar
+            # (torchft_tpu.native.CppManagerServer) — same construction surface
+            self._manager_server = (server_cls or ManagerServer)(
                 replica_id=replica_id,
                 lighthouse_addr=lighthouse_addr,
                 hostname=hostname,

@@ -1,0 +1,671 @@
+"""ctypes bindings for the C++ runtime (``native/libtpuft.so``).
+
+The reference ships its control plane as a Rust cdylib bound via pyo3
+(``src/lib.rs``); torchft_tpu's equivalent is a C++ shared library bound via
+ctypes (no pybind11 in the environment).  The C++ servers speak the exact
+wire protocol of the Python implementations, so the Python clients
+(``RpcClient`` subclasses) work against either — the classes here mirror the
+Python servers' construction surface and are drop-in replacements.
+
+The library is built on demand with ``make`` (g++ -O3); if the toolchain or
+build fails, ``available()`` returns False and callers fall back to the
+pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import queue
+import subprocess
+import threading
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchft_tpu.communicator import (
+    Buffers,
+    Communicator,
+    CommunicatorAborted,
+    CommunicatorError,
+    ReduceOp,
+)
+from torchft_tpu.futures import TimerHandle, schedule_timeout
+from torchft_tpu.work import DummyWork, Work
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtpuft.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_error: Optional[str] = None
+_lib_lock = threading.Lock()
+
+_DTYPE_CODES = {
+    "float32": 0,
+    "float64": 1,
+    "int32": 2,
+    "int64": 3,
+    "bfloat16": 4,
+    "uint8": 5,
+    "int8": 6,
+}
+_OP_CODES = {ReduceOp.SUM: 0, ReduceOp.AVG: 0, ReduceOp.MAX: 1, ReduceOp.MIN: 2}
+
+
+def _build_lib() -> None:
+    sources = [
+        os.path.join(_NATIVE_DIR, f)
+        for f in os.listdir(_NATIVE_DIR)
+        if f.endswith((".cc", ".h"))
+    ]
+    if os.path.exists(_LIB_PATH):
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        if all(os.path.getmtime(s) <= lib_mtime for s in sources):
+            return
+    logger.info("building native runtime (make -C %s)", _NATIVE_DIR)
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR],
+        check=True,
+        capture_output=True,
+        timeout=300,
+    )
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_error
+    with _lib_lock:
+        if _lib is not None or _lib_error is not None:
+            return _lib
+        try:
+            _build_lib()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception as e:  # noqa: BLE001
+            _lib_error = str(e)
+            logger.warning("native runtime unavailable: %s", e)
+            return None
+
+        lib.tpuft_last_error.restype = ctypes.c_char_p
+        lib.tpuft_store_new.restype = ctypes.c_void_p
+        lib.tpuft_store_new.argtypes = [ctypes.c_char_p]
+        lib.tpuft_store_port.argtypes = [ctypes.c_void_p]
+        lib.tpuft_store_free.argtypes = [ctypes.c_void_p]
+        lib.tpuft_lighthouse_new.restype = ctypes.c_void_p
+        lib.tpuft_lighthouse_new.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+        ]
+        lib.tpuft_lighthouse_port.argtypes = [ctypes.c_void_p]
+        lib.tpuft_lighthouse_free.argtypes = [ctypes.c_void_p]
+        lib.tpuft_manager_new.restype = ctypes.c_void_p
+        lib.tpuft_manager_new.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_int64,
+        ]
+        lib.tpuft_manager_port.argtypes = [ctypes.c_void_p]
+        lib.tpuft_manager_free.argtypes = [ctypes.c_void_p]
+        lib.tpuft_comm_new.restype = ctypes.c_void_p
+        lib.tpuft_comm_new.argtypes = [ctypes.c_double]
+        lib.tpuft_comm_configure.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.tpuft_comm_allreduce.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+        ]
+        lib.tpuft_comm_broadcast.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+        ]
+        lib.tpuft_comm_send.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+        ]
+        lib.tpuft_comm_recv_alloc.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.tpuft_buffer_free.argtypes = [ctypes.c_void_p]
+        lib.tpuft_comm_alltoall.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+        ]
+        lib.tpuft_comm_allgather.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+        ]
+        lib.tpuft_comm_barrier.argtypes = [ctypes.c_void_p]
+        lib.tpuft_comm_abort.argtypes = [ctypes.c_void_p]
+        lib.tpuft_comm_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _last_error(lib: ctypes.CDLL) -> str:
+    return lib.tpuft_last_error().decode("utf-8", "replace")
+
+
+# ---------------------------------------------------------------------------
+# server wrappers (drop-in for the Python servers)
+# ---------------------------------------------------------------------------
+
+
+class CppStoreServer:
+    def __init__(self, bind: str = "0.0.0.0:0") -> None:
+        lib = _load()
+        assert lib is not None, "native runtime unavailable"
+        self._lib = lib
+        self._h = lib.tpuft_store_new(bind.encode())
+        if not self._h:
+            raise RuntimeError(f"store server failed: {_last_error(lib)}")
+
+    @property
+    def port(self) -> int:
+        return self._lib.tpuft_store_port(self._h)
+
+    def local_address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def address(self) -> str:
+        import socket
+
+        return f"{socket.gethostname()}:{self.port}"
+
+    def shutdown(self) -> None:
+        if self._h:
+            self._lib.tpuft_store_free(self._h)
+            self._h = None
+
+
+class CppLighthouseServer:
+    def __init__(
+        self,
+        bind: str = "0.0.0.0:0",
+        min_replicas: int = 1,
+        join_timeout_ms: int = 100,
+        quorum_tick_ms: int = 100,
+        heartbeat_timeout_ms: int = 5000,
+    ) -> None:
+        lib = _load()
+        assert lib is not None, "native runtime unavailable"
+        self._lib = lib
+        self._h = lib.tpuft_lighthouse_new(
+            bind.encode(),
+            min_replicas,
+            join_timeout_ms,
+            quorum_tick_ms,
+            heartbeat_timeout_ms,
+        )
+        if not self._h:
+            raise RuntimeError(f"lighthouse failed: {_last_error(lib)}")
+
+    @property
+    def port(self) -> int:
+        return self._lib.tpuft_lighthouse_port(self._h)
+
+    def local_address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def address(self) -> str:
+        import socket
+
+        return f"{socket.gethostname()}:{self.port}"
+
+    def shutdown(self) -> None:
+        if self._h:
+            self._lib.tpuft_lighthouse_free(self._h)
+            self._h = None
+
+
+class CppManagerServer:
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        hostname: str = "",
+        bind: str = "0.0.0.0:0",
+        store_addr: str = "",
+        world_size: int = 1,
+        heartbeat_interval: float = 0.1,
+        connect_timeout: float = 10.0,
+        quorum_retries: int = 0,
+    ) -> None:
+        import socket
+
+        lib = _load()
+        assert lib is not None, "native runtime unavailable"
+        self._lib = lib
+        self._hostname = hostname or socket.gethostname()
+        self._h = lib.tpuft_manager_new(
+            replica_id.encode(),
+            lighthouse_addr.encode(),
+            self._hostname.encode(),
+            bind.encode(),
+            store_addr.encode(),
+            world_size,
+            heartbeat_interval,
+            connect_timeout,
+            quorum_retries,
+        )
+        if not self._h:
+            raise RuntimeError(f"manager server failed: {_last_error(lib)}")
+
+    @property
+    def port(self) -> int:
+        return self._lib.tpuft_manager_port(self._h)
+
+    def address(self) -> str:
+        return f"{self._hostname}:{self.port}"
+
+    def shutdown(self) -> None:
+        if self._h:
+            self._lib.tpuft_manager_free(self._h)
+            self._h = None
+
+
+# ---------------------------------------------------------------------------
+# CppCommunicator
+# ---------------------------------------------------------------------------
+
+
+class CppCommunicator(Communicator):
+    """Data-plane communicator backed by the C++ runtime.
+
+    Same semantics as :class:`torchft_tpu.communicator.TCPCommunicator`
+    (repeatable configure, abort-poisons, per-op userspace timeouts) with the
+    wire IO and reductions in native code.  ctypes releases the GIL during
+    foreign calls, so the op thread never stalls Python.
+    """
+
+    def __init__(self, timeout_s: float = 60.0) -> None:
+        lib = _load()
+        assert lib is not None, "native runtime unavailable"
+        self._lib = lib
+        self._timeout_s = timeout_s
+        self._h = lib.tpuft_comm_new(ctypes.c_double(timeout_s))
+        self._rank = 0
+        self._world_size = 1
+        self._errored: Optional[Exception] = None
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._ops: "queue.Queue[Optional[Tuple[Callable[[], object], Future]]]" = queue.Queue()
+        self._op_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def configure(
+        self,
+        store_addr: str,
+        replica_id: str,
+        rank: int,
+        world_size: int,
+        quorum_id: int = 0,
+        group_rank: int = 0,
+        group_world_size: int = 1,
+        global_ranks: Sequence[int] = (),
+    ) -> None:
+        with self._lock:
+            self._teardown_locked("superseded by reconfigure")
+            self._epoch += 1
+            epoch = self._epoch
+            self._errored = None
+            self._rank = rank
+            self._world_size = world_size
+        # the C configure blocks on rendezvous; run outside the lock
+        rc = self._lib.tpuft_comm_configure(
+            self._h, store_addr.encode(), rank, world_size
+        )
+        if rc != 0:
+            err = CommunicatorError(
+                f"configure failed: {_last_error(self._lib)}"
+            )
+            with self._lock:
+                self._errored = err
+            raise err
+        with self._lock:
+            if self._epoch != epoch:
+                raise CommunicatorAborted("configure superseded")
+            self._ops = queue.Queue()
+            self._op_thread = threading.Thread(
+                target=self._run_ops,
+                args=(self._ops, epoch),
+                name=f"tpuft_cppcomm_ops_{epoch}",
+                daemon=True,
+            )
+            self._op_thread.start()
+        logger.info(
+            "cpp communicator configured: replica_id=%s rank=%d/%d quorum_id=%d",
+            replica_id,
+            rank,
+            world_size,
+            quorum_id,
+        )
+
+    def _teardown_locked(self, reason: str) -> None:
+        # No join here: the op thread's error path takes self._lock, so
+        # joining under the lock would deadlock.  The in-flight C op
+        # observes the abort and errors out; the C layer parks superseded
+        # fds in a graveyard until destruction, so the late-returning op can
+        # never touch a recycled fd.
+        if self._h:
+            self._lib.tpuft_comm_abort(self._h)  # unblocks in-flight op
+        try:
+            while True:
+                item = self._ops.get_nowait()
+                if item is not None:
+                    item[1].set_exception(CommunicatorAborted(reason))
+        except queue.Empty:
+            pass
+        if self._op_thread is not None:
+            self._ops.put(None)
+            self._op_thread = None
+
+    def abort(self, reason: str = "aborted") -> None:
+        with self._lock:
+            if self._errored is None:
+                self._errored = CommunicatorAborted(reason)
+            self._teardown_locked(reason)
+            self._epoch += 1
+        logger.warning("cpp communicator aborted: %s", reason)
+
+    def _abort_if_epoch(self, epoch: int, reason: str) -> None:
+        def _do() -> None:
+            with self._lock:
+                if self._epoch != epoch:
+                    return
+                if self._errored is None:
+                    self._errored = CommunicatorAborted(reason)
+                self._teardown_locked(reason)
+                self._epoch += 1
+            logger.warning("cpp communicator aborted: %s", reason)
+
+        threading.Thread(target=_do, name="tpuft_cppcomm_abort", daemon=True).start()
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    def shutdown(self) -> None:
+        with self._lock:
+            thread = self._op_thread
+            self._teardown_locked("shutdown")
+            if self._errored is None:
+                self._errored = CommunicatorAborted("shutdown")
+            self._epoch += 1
+        # join OUTSIDE the lock (the op thread's error path takes it); the C
+        # object must not be freed while an op thread is inside a C call
+        if thread is not None:
+            thread.join(timeout=15.0)
+        with self._lock:
+            if self._h and (thread is None or not thread.is_alive()):
+                self._lib.tpuft_comm_free(self._h)
+                self._h = None
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._world_size
+
+    def set_timeout(self, timeout_s: float) -> None:
+        self._timeout_s = timeout_s
+
+    # -- op machinery ------------------------------------------------------
+
+    def _run_ops(self, ops: "queue.Queue", epoch: int) -> None:
+        while True:
+            item = ops.get()
+            if item is None:
+                return
+            fn, fut = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            timeout_s = self._timeout_s
+            handle: TimerHandle = schedule_timeout(
+                timeout_s,
+                lambda: self._abort_if_epoch(
+                    epoch, f"op timed out after {timeout_s}s"
+                ),
+            )
+            try:
+                result = fn()
+            except BaseException as e:  # noqa: BLE001
+                with self._lock:
+                    if self._epoch == epoch and self._errored is None:
+                        self._errored = (
+                            e if isinstance(e, Exception) else RuntimeError(str(e))
+                        )
+                fut.set_exception(e)
+            else:
+                fut.set_result(result)
+            finally:
+                handle.cancel()
+
+    def _submit(self, fn: Callable[[], object]) -> Work:
+        with self._lock:
+            if self._errored is not None:
+                fut: Future = Future()
+                fut.set_exception(self._errored)
+                return Work(fut)
+            if self._op_thread is None:
+                fut = Future()
+                fut.set_exception(CommunicatorError("communicator not configured"))
+                return Work(fut)
+            fut = Future()
+            self._ops.put((fn, fut))
+            return Work(fut)
+
+    def _check(self, rc: int, what: str) -> None:
+        if rc != 0:
+            raise CommunicatorError(f"{what} failed: {_last_error(self._lib)}")
+
+    # -- collectives -------------------------------------------------------
+
+    @staticmethod
+    def _as_list(buffers: Buffers) -> List[np.ndarray]:
+        if isinstance(buffers, np.ndarray):
+            return [buffers]
+        return [np.asarray(b) for b in buffers]
+
+    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+        arrays = self._as_list(buffers)
+        single = isinstance(buffers, np.ndarray)
+        ws = self._world_size
+
+        def _run() -> object:
+            out: List[np.ndarray] = [None] * len(arrays)  # type: ignore[list-item]
+            # one contiguous native buffer per dtype
+            by_dtype = {}
+            for i, a in enumerate(arrays):
+                by_dtype.setdefault(a.dtype.name, []).append(i)
+            for dtype_name, idxs in by_dtype.items():
+                code = _DTYPE_CODES.get(dtype_name)
+                if code is None:
+                    raise CommunicatorError(f"unsupported dtype {dtype_name}")
+                if len(idxs) == 1:
+                    # single-buffer fast path: copy once (the native op is
+                    # in-place), no concatenate
+                    flat = np.array(arrays[idxs[0]], copy=True).reshape(-1)
+                else:
+                    flat = np.concatenate(
+                        [np.ascontiguousarray(arrays[i]).reshape(-1) for i in idxs]
+                    )
+                self._check(
+                    self._lib.tpuft_comm_allreduce(
+                        self._h,
+                        flat.ctypes.data_as(ctypes.c_void_p)
+                        if flat.dtype.name != "bfloat16"
+                        else flat.view(np.uint8).ctypes.data_as(ctypes.c_void_p),
+                        flat.nbytes,
+                        code,
+                        _OP_CODES[op],
+                    ),
+                    "allreduce",
+                )
+                if op == ReduceOp.AVG:
+                    if np.issubdtype(flat.dtype, np.integer):
+                        flat //= ws
+                    else:
+                        np.divide(flat, ws, out=flat)
+                off = 0
+                for i in idxs:
+                    n = arrays[i].size
+                    out[i] = flat[off : off + n].reshape(arrays[i].shape)
+                    off += n
+            return out[0] if single else out
+
+        return self._submit(_run)
+
+    def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
+        arrays = [np.ascontiguousarray(a) for a in self._as_list(buffers)]
+        single = isinstance(buffers, np.ndarray)
+
+        def _run() -> object:
+            out = []
+            for a in arrays:
+                buf = np.array(a, copy=True)
+                view = buf.reshape(-1).view(np.uint8)
+                self._check(
+                    self._lib.tpuft_comm_broadcast(
+                        self._h,
+                        view.ctypes.data_as(ctypes.c_void_p),
+                        view.nbytes,
+                        root,
+                    ),
+                    "broadcast",
+                )
+                out.append(buf)
+            return out[0] if single else out
+
+        return self._submit(_run)
+
+    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
+        def _run() -> object:
+            self._check(
+                self._lib.tpuft_comm_send(self._h, data, len(data), dst, tag),
+                "send",
+            )
+            return len(data)
+
+        return self._submit(_run)
+
+    def recv_bytes(self, src: int, tag: int = 0) -> Work:
+        def _run() -> object:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = ctypes.c_uint64()
+            self._check(
+                self._lib.tpuft_comm_recv_alloc(
+                    self._h, src, tag, ctypes.byref(out), ctypes.byref(n)
+                ),
+                "recv",
+            )
+            try:
+                return ctypes.string_at(out, n.value)
+            finally:
+                self._lib.tpuft_buffer_free(out)
+
+        return self._submit(_run)
+
+    def alltoall(self, chunks: List[np.ndarray], tag: int = 0) -> Work:
+        arrays = [np.ascontiguousarray(c) for c in chunks]
+
+        def _run() -> object:
+            ws = self._world_size
+            if ws == 1:
+                return [arrays[0]]
+            assert len(arrays) == ws
+            chunk_bytes = arrays[0].nbytes
+            assert all(a.nbytes == chunk_bytes for a in arrays), (
+                "cpp alltoall requires equal-size chunks"
+            )
+            packed = np.concatenate([a.reshape(-1).view(np.uint8) for a in arrays])
+            out = np.empty(ws * chunk_bytes, dtype=np.uint8)
+            self._check(
+                self._lib.tpuft_comm_alltoall(
+                    self._h,
+                    packed.ctypes.data_as(ctypes.c_void_p),
+                    out.ctypes.data_as(ctypes.c_void_p),
+                    chunk_bytes,
+                    tag,
+                ),
+                "alltoall",
+            )
+            return [
+                out[p * chunk_bytes : (p + 1) * chunk_bytes]
+                .view(arrays[0].dtype)
+                .reshape(arrays[0].shape)
+                for p in range(ws)
+            ]
+
+        return self._submit(_run)
+
+    def allgather(self, data: np.ndarray, tag: int = 0) -> Work:
+        array = np.ascontiguousarray(data)
+
+        def _run() -> object:
+            ws = self._world_size
+            if ws == 1:
+                return [array]
+            chunk_bytes = array.nbytes
+            out = np.empty(ws * chunk_bytes, dtype=np.uint8)
+            self._check(
+                self._lib.tpuft_comm_allgather(
+                    self._h,
+                    array.reshape(-1).view(np.uint8).ctypes.data_as(ctypes.c_void_p),
+                    out.ctypes.data_as(ctypes.c_void_p),
+                    chunk_bytes,
+                    tag,
+                ),
+                "allgather",
+            )
+            return [
+                out[p * chunk_bytes : (p + 1) * chunk_bytes]
+                .view(array.dtype)
+                .reshape(array.shape)
+                for p in range(ws)
+            ]
+
+        return self._submit(_run)
+
+    def barrier(self) -> Work:
+        def _run() -> object:
+            self._check(self._lib.tpuft_comm_barrier(self._h), "barrier")
+            return None
+
+        return self._submit(_run)
