@@ -55,8 +55,17 @@ def _quantized_reduce_scatter_sync(
 
     Returns (reduced q shard, its scales, total unpadded rows, rows/rank).
     """
-    ws = comm.size()
     q, scales = quantize_int8_rowwise(flat, row_size)
+    return _prequantized_reduce_scatter_sync(comm, q, scales, tag)
+
+
+def _prequantized_reduce_scatter_sync(
+    comm: Communicator, q: np.ndarray, scales: np.ndarray, tag: int
+) -> Tuple[np.ndarray, np.ndarray, int, int]:
+    """Same core for input already quantized (e.g. on-device by the Pallas
+    kernel, so only int8+scales ever crossed HBM→host)."""
+    ws = comm.size()
+    row_size = q.shape[1]
     rows = q.shape[0]
     rows_per_rank = -(-rows // ws)
     padded_rows = rows_per_rank * ws
@@ -98,23 +107,14 @@ def _allreduce_quantized_sync(
         # peers would wedge in their allgather (FakeCommunicatorWrapper
         # contract). Participate with a zero shard, then re-raise.
         pipeline_err = e
-        ws = comm.size()
-        rows = max(1, -(-flat.size // row_size))
-        rows_per_rank = -(-rows // ws)
-        q_red = np.zeros((rows_per_rank, row_size), np.int8)
-        s_red = np.zeros(rows_per_rank, np.float32)
+        q_red, s_red, rows, rows_per_rank = _zero_shard(
+            max(1, -(-flat.size // row_size)), row_size, comm.size()
+        )
 
-    all_shards = comm.allgather(_pack(q_red, s_red), tag=102).wait()
-    if pipeline_err is not None:
-        raise pipeline_err
-
-    row_size_ = q_red.shape[1]
-    qs_full, ss_full = zip(
-        *(_unpack(s, rows_per_rank, row_size_) for s in all_shards)
+    summed = _allgather_reduced_shards(
+        comm, q_red, s_red, rows, rows_per_rank, row_size, flat.size, tag=102,
+        pipeline_err=pipeline_err,
     )
-    q_full = np.concatenate(qs_full)[:rows]
-    s_full = np.concatenate(ss_full)[:rows]
-    summed = dequantize_int8_rowwise(q_full, s_full, flat.size, np.float32)
 
     out: List[np.ndarray] = []
     off = 0
@@ -124,6 +124,76 @@ def _allreduce_quantized_sync(
         )
         off += size
     return out
+
+
+def _allgather_reduced_shards(
+    comm: Communicator,
+    q_red: np.ndarray,
+    s_red: np.ndarray,
+    rows: int,
+    rows_per_rank: int,
+    row_size: int,
+    n: int,
+    tag: int,
+    pipeline_err: Optional[BaseException],
+) -> np.ndarray:
+    """Shared tail of both quantized allreduces: allgather the reduced
+    shards and dequantize.  Always participates in the allgather — even
+    after an upstream failure (``pipeline_err``), a zero shard is
+    contributed so healthy peers are never wedged — then re-raises."""
+    all_shards = comm.allgather(_pack(q_red, s_red), tag=tag).wait()
+    if pipeline_err is not None:
+        raise pipeline_err
+    qs_full, ss_full = zip(
+        *(_unpack(s, rows_per_rank, row_size) for s in all_shards)
+    )
+    q_full = np.concatenate(qs_full)[:rows]
+    s_full = np.concatenate(ss_full)[:rows]
+    return dequantize_int8_rowwise(q_full, s_full, n, np.float32)
+
+
+def _zero_shard(
+    rows: int, row_size: int, ws: int
+) -> Tuple[np.ndarray, np.ndarray, int, int]:
+    """Zero contribution with the shard geometry peers expect (``rows`` must
+    equal the unpadded row count every rank derived from its own input)."""
+    rows_per_rank = -(-rows // ws)
+    return (
+        np.zeros((rows_per_rank, row_size), np.int8),
+        np.zeros(rows_per_rank, np.float32),
+        rows,
+        rows_per_rank,
+    )
+
+
+def allreduce_prequantized(
+    comm: Communicator,
+    q: np.ndarray,
+    scales: np.ndarray,
+    n: int,
+) -> np.ndarray:
+    """SUM-allreduce of an already-quantized stream (int8 rows + f32 rowwise
+    scales, e.g. produced on device by ``ops.pallas_quant``); returns the
+    dequantized float32 sum of length ``n``.  Synchronous — callers layer
+    Work/threading on top (``Manager.allreduce_prequantized``)."""
+    scales = np.asarray(scales).reshape(-1)
+    if comm.size() == 1 or getattr(comm, "is_passthrough", False):
+        return dequantize_int8_rowwise(q, scales, n, np.float32)
+    row_size = q.shape[1]
+    err: Optional[BaseException] = None
+    try:
+        q_red, s_red, rows, rows_per_rank = _prequantized_reduce_scatter_sync(
+            comm, q, scales, tag=105
+        )
+    except BaseException as e:  # noqa: BLE001 — still join the allgather
+        err = e
+        q_red, s_red, rows, rows_per_rank = _zero_shard(
+            q.shape[0], row_size, comm.size()
+        )
+    return _allgather_reduced_shards(
+        comm, q_red, s_red, rows, rows_per_rank, row_size, n, tag=106,
+        pipeline_err=err,
+    )
 
 
 def allreduce_quantized(

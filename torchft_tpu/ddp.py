@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from torchft_tpu.manager import Manager
@@ -45,6 +46,15 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
         return allreduce_pytree_result(tree)
 
     leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return allreduce_pytree_result(tree)
+
+    if should_quantize and all(isinstance(l, jax.Array) for l in leaves):
+        # Quantize ON DEVICE (Pallas on TPU): only int8 payload + rowwise
+        # scales cross HBM→host→DCN — ~4x fewer bytes than shipping floats
+        # and quantizing host-side.
+        return _allreduce_pytree_device_quantized(manager, leaves, treedef)
+
     original = list(leaves)
 
     # bucket by dtype so each dtype rides one ring (DDP-style flat buckets)
@@ -89,6 +99,48 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
         return jax.tree_util.tree_unflatten(treedef, out)
 
     return work.then(_unbucket)
+
+
+@jax.jit
+def _flatten_f32(leaves: Any) -> jax.Array:
+    return jnp.concatenate([l.astype(jnp.float32).reshape(-1) for l in leaves])
+
+
+def _allreduce_pytree_device_quantized(
+    manager: Manager, leaves: list, treedef: Any
+) -> Work:
+    """Device quantize → Manager-orchestrated wire pipeline → device put.
+
+    The fault-tolerance orchestration (quorum wait, participation zeroing,
+    normalization, error funnel) lives in ``Manager.allreduce_prequantized``
+    — this function only handles device-side quantization and pytree
+    reassembly.  Returns a pending Work (the wire pipeline runs off-thread).
+    """
+    from torchft_tpu.ops.pallas_quant import quantize_int8_rowwise_device
+
+    try:
+        flat = _flatten_f32(leaves)
+        q, scales = quantize_int8_rowwise_device(flat)
+        # the only HBM→host bytes: int8 payload + f32 rowwise scales
+        q_np, s_np = np.asarray(q), np.asarray(scales)
+        work = manager.allreduce_prequantized(q_np, s_np, int(flat.shape[0]))
+    except Exception as e:  # noqa: BLE001 — errors never reach the train loop
+        manager.report_error(e)
+        return DummyWork(jax.tree_util.tree_unflatten(treedef, leaves))
+
+    def _reassemble(avg: np.ndarray) -> Any:
+        out = []
+        off = 0
+        for leaf in leaves:
+            n = leaf.size
+            host_val = avg[off : off + n].reshape(leaf.shape)
+            out.append(jax.device_put(host_val.astype(leaf.dtype), leaf.sharding))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return manager.wrap_work(
+        work.then(_reassemble), jax.tree_util.tree_unflatten(treedef, leaves)
+    )
 
 
 def ft_allreduce(manager: Manager, tree: Any, should_quantize: bool = False) -> Any:

@@ -581,6 +581,52 @@ class Manager:
             self.report_error(e)
             return DummyWork(data)
 
+    def allreduce_prequantized(
+        self, q: np.ndarray, scales: np.ndarray, n: int
+    ) -> Work:
+        """Fault-tolerant SUM-allreduce of an already-quantized stream (int8
+        rows + rowwise f32 scales, e.g. quantized on device by
+        ``ops.pallas_quant``), normalized by ``num_participants()``.
+
+        Same orchestration contract as :meth:`allreduce`: waits the quorum,
+        zeroes the contribution of non-participants, swallows errors into a
+        failed vote, and returns a pending Work (the wire pipeline runs
+        off-thread) whose value is the averaged float32 array of length
+        ``n``.  On error the value is this replica's own dequantized
+        contribution, mirroring the unquantized input-passthrough."""
+        from torchft_tpu.collectives import allreduce_prequantized
+        from torchft_tpu.quantization import dequantize_int8_rowwise
+
+        def _own_value() -> np.ndarray:
+            return dequantize_int8_rowwise(
+                q, np.asarray(scales).reshape(-1), n, np.float32
+            )
+
+        if self.errored():
+            return DummyWork(_own_value())
+
+        self.wait_quorum()
+        num_participants = self.num_participants()
+        q_in, s_in = q, scales
+        if not self.is_participating():
+            q_in = np.zeros_like(q)
+            s_in = np.zeros_like(scales)
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _run() -> None:
+            try:
+                summed = allreduce_prequantized(self._comm, q_in, s_in, n)
+                fut.set_result(summed / num_participants)
+            except Exception as e:  # noqa: BLE001 — funnel, never raise
+                self.report_error(e)
+                fut.set_result(_own_value())
+
+        threading.Thread(
+            target=_run, name="tpuft_prequantized_allreduce", daemon=True
+        ).start()
+        return Work(fut)
+
     # ------------------------------------------------------------------
     # commit
     # ------------------------------------------------------------------

@@ -1,0 +1,24 @@
+"""Device-side kernels (Pallas TPU, with jnp fallbacks off-TPU)."""
+
+_LAZY = {
+    "quantize_int8_rowwise_device": (
+        "torchft_tpu.ops.pallas_quant",
+        "quantize_int8_rowwise_device",
+    ),
+    "dequantize_int8_rowwise_device": (
+        "torchft_tpu.ops.pallas_quant",
+        "dequantize_int8_rowwise_device",
+    ),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)
