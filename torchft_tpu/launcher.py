@@ -1,0 +1,213 @@
+"""Replica-group launcher: run an FT job on one or many hosts.
+
+The reference ships a TorchX component that launches N single-node torchrun
+roles with ``REPLICA_GROUP_ID`` / ``NUM_REPLICA_GROUPS`` env plumbing
+(``torchft/torchx.py:17-89``) plus a SLURM runner
+(``torchft/examples/slurm/runner.py``).  torchft_tpu's launcher does the
+same job for TPU-VM style deployments: spawn one training process per
+replica group, each pointed at the shared lighthouse, with automatic restart
+of crashed groups (the scheduler role the reference delegates to
+torchx/SLURM/Monarch).
+
+CLI::
+
+    python -m torchft_tpu.launcher --replicas 2 --min-replicas 1 \
+        -- python examples/train_ddp.py --steps 100
+
+Env contract for the child (same names as the reference):
+``TORCHFT_LIGHTHOUSE``, ``REPLICA_GROUP_ID``, ``NUM_REPLICA_GROUPS``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("torchft_tpu.launcher")
+
+
+@dataclass
+class ReplicaSpec:
+    replica_group_id: int
+    cmd: List[str]
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+class ReplicaSupervisor:
+    """Spawn + monitor + restart replica-group processes.
+
+    ``max_restarts`` bounds per-group restarts (None = unlimited), matching
+    the respawn loop of the reference's SLURM/Monarch orchestrators.
+    """
+
+    def __init__(
+        self,
+        specs: List[ReplicaSpec],
+        lighthouse_addr: str,
+        max_restarts: Optional[int] = None,
+        restart_delay_s: float = 1.0,
+    ) -> None:
+        self._specs = specs
+        self._lighthouse_addr = lighthouse_addr
+        self._max_restarts = max_restarts
+        self._restart_delay_s = restart_delay_s
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._restarts: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def _spawn(self, spec: ReplicaSpec) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update(spec.env)
+        env["TORCHFT_LIGHTHOUSE"] = self._lighthouse_addr
+        env["REPLICA_GROUP_ID"] = str(spec.replica_group_id)
+        env["NUM_REPLICA_GROUPS"] = str(len(self._specs))
+        logger.info(
+            "launching replica group %d: %s", spec.replica_group_id, spec.cmd
+        )
+        return subprocess.Popen(spec.cmd, env=env)
+
+    def run(self) -> int:
+        """Run until every group exits cleanly (rc 0) or is out of restarts.
+        Returns the worst exit code."""
+        with self._lock:
+            for spec in self._specs:
+                self._procs[spec.replica_group_id] = self._spawn(spec)
+                self._restarts[spec.replica_group_id] = 0
+
+        worst_rc = 0
+        alive = {spec.replica_group_id for spec in self._specs}
+        while alive and not self._stop.is_set():
+            time.sleep(0.2)
+            for spec in self._specs:
+                gid = spec.replica_group_id
+                if gid not in alive:
+                    continue
+                proc = self._procs[gid]
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    logger.info("replica group %d finished", gid)
+                    alive.discard(gid)
+                    continue
+                # crash: restart (the whole point of per-step fault tolerance
+                # is that the surviving groups kept training meanwhile)
+                self._restarts[gid] += 1
+                if (
+                    self._max_restarts is not None
+                    and self._restarts[gid] > self._max_restarts
+                ):
+                    logger.error(
+                        "replica group %d exceeded max_restarts (%d), giving up",
+                        gid,
+                        self._max_restarts,
+                    )
+                    # poll() reports signal deaths as negative; a permanently
+                    # failed group must never read as success
+                    worst_rc = max(worst_rc, abs(rc) or 1)
+                    alive.discard(gid)
+                    continue
+                logger.warning(
+                    "replica group %d exited rc=%d; restarting (%d)",
+                    gid,
+                    rc,
+                    self._restarts[gid],
+                )
+                time.sleep(self._restart_delay_s)
+                self._procs[gid] = self._spawn(spec)
+        return worst_rc
+
+    def kill(self, replica_group_id: int, sig: int = signal.SIGKILL) -> bool:
+        """Chaos hook: kill one group's process (it will be restarted)."""
+        with self._lock:
+            proc = self._procs.get(replica_group_id)
+        if proc is None or proc.poll() is not None:
+            return False
+        proc.send_signal(sig)
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            for proc in self._procs.values():
+                if proc.poll() is None:
+                    proc.terminate()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        "torchft_tpu.launcher",
+        description="Launch N fault-tolerant replica groups + a lighthouse.",
+    )
+    parser.add_argument("--replicas", type=int, required=True)
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument(
+        "--lighthouse",
+        default=None,
+        help="existing lighthouse addr; if unset, one is started in-process",
+    )
+    parser.add_argument("--join-timeout-ms", type=int, default=60_000)
+    parser.add_argument("--max-restarts", type=int, default=None)
+    parser.add_argument(
+        "--native",
+        action="store_true",
+        help="serve the lighthouse from the C++ runtime",
+    )
+    parser.add_argument("cmd", nargs=argparse.REMAINDER, help="-- <training cmd>")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("training command required after --")
+
+    lighthouse = None
+    lighthouse_addr = args.lighthouse
+    if lighthouse_addr is None:
+        if args.native:
+            from torchft_tpu.native import CppLighthouseServer
+
+            lighthouse = CppLighthouseServer(
+                bind="0.0.0.0:0",
+                min_replicas=args.min_replicas,
+                join_timeout_ms=args.join_timeout_ms,
+            )
+        else:
+            from torchft_tpu.lighthouse import LighthouseServer
+
+            lighthouse = LighthouseServer(
+                bind="0.0.0.0:0",
+                min_replicas=args.min_replicas,
+                join_timeout_ms=args.join_timeout_ms,
+            )
+        lighthouse_addr = f"127.0.0.1:{lighthouse.port}"
+        logger.info("started lighthouse on %s", lighthouse_addr)
+
+    specs = [ReplicaSpec(replica_group_id=i, cmd=list(cmd)) for i in range(args.replicas)]
+    supervisor = ReplicaSupervisor(
+        specs, lighthouse_addr, max_restarts=args.max_restarts
+    )
+    try:
+        rc = supervisor.run()
+    except KeyboardInterrupt:
+        supervisor.stop()
+        rc = 130
+    finally:
+        if lighthouse is not None:
+            lighthouse.shutdown()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, TypeVar, Union, cast
 import numpy as np
 
 from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.observability import QuorumTracer, record_function, traced
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.communicator import Communicator, ReduceOp
 from torchft_tpu.manager_server import ManagerClient, ManagerServer
@@ -122,6 +123,9 @@ class Manager:
         _peer_client_factory: Optional[Callable[[str], ManagerClient]] = None,
         server_cls: Optional[type] = None,
     ) -> None:
+        from torchft_tpu.observability import init_structured_logging
+
+        init_structured_logging()  # no-op unless TORCHFT_USE_OTEL/LOG_DIR set
         self.quorum_logger = logging.getLogger("torchft_quorums")
         self.commits_logger = logging.getLogger("torchft_commits")
         self.errors_logger = logging.getLogger("torchft_errors")
@@ -153,6 +157,8 @@ class Manager:
 
         # state dict guard: reads (checkpoint serving) vs writes (train loop)
         self._state_dict_lock = RWLock(timeout=self._timeout)
+        # per-quorum profiler epochs (TORCHFT_TRACE_DIR; flight-recorder analog)
+        self._tracer = QuorumTracer()
 
         self._pending_state_dict: Optional[Dict[str, object]] = None
         self._healing = False
@@ -353,6 +359,7 @@ class Manager:
                 self._apply_pending_state_dict()
                 self._healing = False
 
+    @traced("torchft::manager::wait_quorum")
     def wait_quorum(self) -> None:
         """Block until the pending quorum completes; the communicator is in a
         healthy (re)configured state afterwards (``manager.py:617-627``)."""
@@ -361,6 +368,7 @@ class Manager:
         )
         self._quorum_future.result()
 
+    @traced("torchft::manager::_async_quorum")
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
@@ -423,6 +431,8 @@ class Manager:
             self._logger.info(
                 f"reconfiguring for quorum_id={quorum_id} store={store_prefixed_addr}"
             )
+            # fresh profiler epoch per quorum (flight-recorder analog)
+            self._tracer.on_quorum_change(quorum_id)
             try:
                 self._quorum_id = quorum_id
                 self._comm.configure(
@@ -631,6 +641,7 @@ class Manager:
     # commit
     # ------------------------------------------------------------------
 
+    @traced("torchft::manager::should_commit")
     def should_commit(self, timeout: Optional[float] = None) -> bool:
         """Vote on committing this step (``manager.py:855-943``)."""
         # fence recovery before voting
@@ -727,6 +738,7 @@ class Manager:
     # ------------------------------------------------------------------
 
     def shutdown(self) -> None:
+        self._tracer.stop()  # flush the final quorum epoch's trace
         self._checkpoint_transport.shutdown(wait=False)
         if self._quorum_future is not None:
             try:

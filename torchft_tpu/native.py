@@ -37,7 +37,14 @@ from torchft_tpu.work import DummyWork, Work
 
 logger = logging.getLogger(__name__)
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+# Native sources live beside the repo checkout; for installed wheels (where
+# no sibling native/ exists) point TORCHFT_NATIVE_DIR at a sources/lib dir.
+_NATIVE_DIR = os.environ.get(
+    "TORCHFT_NATIVE_DIR",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+    ),
+)
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libtpuft.so")
 
 _lib: Optional[ctypes.CDLL] = None

@@ -1,0 +1,209 @@
+"""Observability: structured event logs + per-quorum profiler traces.
+
+Reference analogs:
+
+- ``torchft/otel.py``: opt-in structured loggers ``torchft_quorums`` /
+  ``torchft_commits`` / ``torchft_errors`` with job/replica/rank/quorum/step
+  attributes, exported over OTLP.  The Manager already emits to those logger
+  names; this module attaches exporters.  OTLP is used when the
+  ``opentelemetry`` SDK is importable; otherwise events are emitted as JSON
+  lines (console or ``TORCHFT_LOG_DIR`` files) — same schema, greppable.
+- ``torch.profiler.record_function`` spans on every protocol phase
+  (``manager.py:410`` etc.) → :func:`record_function` using jax's profiler
+  trace annotations.
+- Per-quorum NCCL flight-recorder dirs (``manager.py:815-824``) →
+  :class:`QuorumTracer`: with ``TORCHFT_TRACE_DIR`` set, each quorum epoch
+  gets its own jax profiler trace directory ``quorum_{id}/``, so the
+  post-mortem for a failed epoch is isolated exactly like an FR dump.
+
+Everything is opt-in via env (``TORCHFT_USE_OTEL``, ``TORCHFT_LOG_DIR``,
+``TORCHFT_TRACE_DIR``); the default is zero overhead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Iterator, Optional
+
+USE_OTEL_ENV = "TORCHFT_USE_OTEL"
+LOG_DIR_ENV = "TORCHFT_LOG_DIR"
+TRACE_DIR_ENV = "TORCHFT_TRACE_DIR"
+
+STRUCTURED_LOGGERS = ("torchft_quorums", "torchft_commits", "torchft_errors")
+
+_ATTR_KEYS = (
+    "job_id",
+    "replica_id",
+    "rank",
+    "quorum_id",
+    "step",
+    "commit_result",
+    "error",
+)
+
+_initialized = False
+_init_lock = threading.Lock()
+
+
+class _JsonLinesFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        event = {
+            "ts": round(time.time(), 3),
+            "event": record.name,
+        }
+        for key in _ATTR_KEYS:
+            if hasattr(record, key):
+                event[key] = getattr(record, key)
+        return json.dumps(event)
+
+
+def init_structured_logging(force: bool = False) -> bool:
+    """Attach exporters to the structured loggers (idempotent).
+
+    Returns True when exporters were attached (env opted in or ``force``).
+    """
+    global _initialized
+    with _init_lock:
+        if _initialized:
+            return True
+        opted_in = force or os.environ.get(USE_OTEL_ENV, "").lower() in (
+            "1",
+            "true",
+        ) or bool(os.environ.get(LOG_DIR_ENV))
+        if not opted_in:
+            return False
+
+        handlers: list[logging.Handler] = []
+        log_dir = os.environ.get(LOG_DIR_ENV)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+
+        try:  # OTLP when the SDK exists (not baked into this environment)
+            from opentelemetry._logs import set_logger_provider  # type: ignore[import-not-found]
+            from opentelemetry.exporter.otlp.proto.grpc._log_exporter import (  # type: ignore[import-not-found]
+                OTLPLogExporter,
+            )
+            from opentelemetry.sdk._logs import (  # type: ignore[import-not-found]
+                LoggerProvider,
+                LoggingHandler,
+            )
+            from opentelemetry.sdk._logs.export import (  # type: ignore[import-not-found]
+                BatchLogRecordProcessor,
+            )
+
+            provider = LoggerProvider()
+            provider.add_log_record_processor(
+                BatchLogRecordProcessor(OTLPLogExporter())
+            )
+            set_logger_provider(provider)
+            handlers.append(LoggingHandler(logger_provider=provider))
+        except ImportError:
+            pass
+
+        for name in STRUCTURED_LOGGERS:
+            logger = logging.getLogger(name)
+            logger.setLevel(logging.INFO)
+            logger.propagate = False
+            if log_dir:
+                fh = logging.FileHandler(os.path.join(log_dir, f"{name}.jsonl"))
+                fh.setFormatter(_JsonLinesFormatter())
+                logger.addHandler(fh)
+            else:
+                sh = logging.StreamHandler(sys.stderr)
+                sh.setFormatter(_JsonLinesFormatter())
+                logger.addHandler(sh)
+            for h in handlers:
+                logger.addHandler(h)
+        _initialized = True
+        return True
+
+
+def traced(name: str):
+    """Decorator form of :func:`record_function` for whole protocol verbs."""
+
+    def _wrap(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def _inner(*args, **kwargs):
+            with record_function(name):
+                return fn(*args, **kwargs)
+
+        return _inner
+
+    return _wrap
+
+
+@contextlib.contextmanager
+def record_function(name: str) -> Iterator[None]:
+    """Protocol-phase span (``torch.profiler.record_function`` analog): shows
+    up in jax profiler traces as a named annotation; free when no trace is
+    being captured."""
+    # resolve the annotation class BEFORE entering the body so an
+    # ImportError raised by the wrapped code is never swallowed here
+    try:
+        from jax.profiler import TraceAnnotation
+    except ImportError:  # pragma: no cover
+        TraceAnnotation = None
+    if TraceAnnotation is None:  # pragma: no cover
+        yield
+    else:
+        with TraceAnnotation(name):
+            yield
+
+
+class QuorumTracer:
+    """Per-quorum-epoch jax profiler traces (flight-recorder analog).
+
+    With ``TORCHFT_TRACE_DIR`` set, call ``on_quorum_change(quorum_id)`` from
+    the manager at each reconfiguration: the previous epoch's trace is closed
+    and a fresh one starts under ``{dir}/quorum_{id}``.
+    """
+
+    def __init__(self, base_dir: Optional[str] = None) -> None:
+        self._base_dir = base_dir or os.environ.get(TRACE_DIR_ENV)
+        self._active = False
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._base_dir)
+
+    def on_quorum_change(self, quorum_id: int) -> None:
+        if not self.enabled:
+            return
+        import jax.profiler
+
+        with self._lock:
+            if self._active:
+                try:
+                    jax.profiler.stop_trace()
+                except RuntimeError:
+                    pass
+                self._active = False
+            path = os.path.join(self._base_dir, f"quorum_{quorum_id}")
+            os.makedirs(path, exist_ok=True)
+            try:
+                jax.profiler.start_trace(path)
+                self._active = True
+            except RuntimeError:
+                pass
+
+    def stop(self) -> None:
+        if not self.enabled:
+            return
+        import jax.profiler
+
+        with self._lock:
+            if self._active:
+                try:
+                    jax.profiler.stop_trace()
+                except RuntimeError:
+                    pass
+                self._active = False
