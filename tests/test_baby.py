@@ -1,0 +1,81 @@
+"""BabyCommunicator tests: subprocess isolation of the data plane
+(reference analog: BabyGloo/BabyNCCL conformance + resiliency,
+``process_group_test.py:952-1027``)."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.baby import BabyCommunicator
+from torchft_tpu.communicator import CommunicatorAborted, ReduceOp
+from torchft_tpu.multiprocessing import MonitoredPipe
+from torchft_tpu.store import StoreServer
+
+
+def test_monitored_pipe() -> None:
+    import multiprocessing as mp
+
+    a, b = mp.Pipe()
+    pa, pb = MonitoredPipe(a), MonitoredPipe(b)
+    pa.send(42)
+    assert pb.recv(timeout=1.0) == 42
+    with pytest.raises(TimeoutError):
+        pb.recv(timeout=0.1)
+    pa.send(RuntimeError("shipped"))
+    with pytest.raises(RuntimeError, match="shipped"):
+        pb.recv(timeout=1.0)
+
+
+@pytest.fixture()
+def store():
+    server = StoreServer("127.0.0.1:0")
+    yield server
+    server.shutdown()
+
+
+def test_baby_allreduce_two_ranks(store) -> None:
+    def _one(rank: int):
+        comm = BabyCommunicator(timeout_s=30.0)
+        comm.configure(
+            f"127.0.0.1:{store.port}/baby",
+            replica_id=f"r{rank}",
+            rank=rank,
+            world_size=2,
+        )
+        try:
+            data = np.full(257, float(rank + 1), dtype=np.float32)
+            out = comm.allreduce(data, ReduceOp.SUM).wait(timeout=30.0)
+            comm.barrier().wait(timeout=30.0)
+            return out
+        finally:
+            comm.shutdown()
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        results = list(pool.map(_one, range(2)))
+    for res in results:
+        np.testing.assert_allclose(res, np.full(257, 3.0))
+
+
+def test_baby_kill_recovers(store) -> None:
+    """Killing the child (a wedge no abort can reach) fails in-flight work
+    and a reconfigure respawns a healthy child."""
+    comm = BabyCommunicator(timeout_s=10.0)
+    comm.configure(
+        f"127.0.0.1:{store.port}/solo", replica_id="r", rank=0, world_size=1
+    )
+    # healthy single-rank op
+    out = comm.allreduce(np.ones(4, dtype=np.float32)).wait(timeout=10.0)
+    np.testing.assert_allclose(out, np.ones(4))
+
+    comm.abort("injected wedge")
+    work = comm.allreduce(np.ones(4, dtype=np.float32))
+    assert isinstance(work.exception(timeout=5.0), CommunicatorAborted)
+
+    comm.configure(
+        f"127.0.0.1:{store.port}/solo2", replica_id="r", rank=0, world_size=1
+    )
+    out = comm.allreduce(np.full(4, 2.0, dtype=np.float32)).wait(timeout=10.0)
+    np.testing.assert_allclose(out, np.full(4, 2.0))
+    comm.shutdown()

@@ -1,0 +1,279 @@
+"""BabyCommunicator: the data plane in a killable subprocess.
+
+Twin of the reference's Baby process groups
+(``torchft/process_group.py:1356-2118``): the real communicator runs in a
+**spawned subprocess**, so comms wedged beyond what ``abort()`` can unblock
+(kernel-stuck sockets, a hung native runtime) are recovered by killing the
+child — the training process survives.  Requests travel over a command pipe;
+results return over a future pipe serviced by a listener thread
+(``process_group.py:1697-1730``).
+
+Differences from the reference: no CUDA stream replication is needed (our
+data plane is host numpy), and buffers ship by pickle rather than shared
+memory — correctness first; a shared-memory ring is a straightforward later
+optimization for multi-GB gradients.
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing as mp
+import threading
+from concurrent.futures import Future
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from torchft_tpu.communicator import (
+    Buffers,
+    Communicator,
+    CommunicatorAborted,
+    CommunicatorError,
+    ReduceOp,
+)
+from torchft_tpu.multiprocessing import MonitoredPipe
+from torchft_tpu.work import Work
+
+logger = logging.getLogger(__name__)
+
+
+def _worker_main(cmd_pipe, out_pipe, backend: str, timeout_s: float) -> None:
+    """Child process: owns the real communicator, executes shipped ops."""
+    try:
+        if backend == "cpp":
+            from torchft_tpu.native import CppCommunicator
+
+            comm: Communicator = CppCommunicator(timeout_s=timeout_s)
+        else:
+            from torchft_tpu.communicator import TCPCommunicator
+
+            comm = TCPCommunicator(timeout_s=timeout_s)
+    except Exception as e:  # noqa: BLE001
+        out_pipe.send((-1, RuntimeError(f"baby worker init failed: {e}")))
+        return
+
+    while True:
+        try:
+            msg = cmd_pipe.recv()
+        except (EOFError, OSError):
+            break
+        if msg is None:
+            break
+        op_id, op, args = msg
+        try:
+            if op == "configure":
+                comm.configure(**args)
+                result = None
+            elif op == "allreduce":
+                result = comm.allreduce(args["buffers"], args["op"]).wait(
+                    timeout=timeout_s
+                )
+            elif op == "broadcast":
+                result = comm.broadcast(args["buffers"], args["root"]).wait(
+                    timeout=timeout_s
+                )
+            elif op == "send_bytes":
+                result = comm.send_bytes(args["data"], args["dst"], args["tag"]).wait(
+                    timeout=timeout_s
+                )
+            elif op == "recv_bytes":
+                result = comm.recv_bytes(args["src"], args["tag"]).wait(
+                    timeout=timeout_s
+                )
+            elif op == "barrier":
+                result = comm.barrier().wait(timeout=timeout_s)
+            else:
+                raise CommunicatorError(f"unknown baby op {op}")
+            out_pipe.send((op_id, result))
+        except Exception as e:  # noqa: BLE001 — ship to the parent
+            try:
+                out_pipe.send((op_id, RuntimeError(str(e))))
+            except (OSError, ValueError):
+                break
+    comm.shutdown()
+
+
+class BabyCommunicator(Communicator):
+    """Runs a TCP or C++ communicator inside a spawned subprocess.
+
+    ``abort()`` escalates to killing the child (the whole point: recovery
+    from wedges no in-process abort can reach); the next ``configure()``
+    respawns it.
+    """
+
+    def __init__(self, timeout_s: float = 60.0, backend: str = "tcp") -> None:
+        self._timeout_s = timeout_s
+        self._backend = backend
+        self._ctx = mp.get_context("spawn")
+        self._proc: Optional[mp.process.BaseProcess] = None
+        self._cmd: Optional[MonitoredPipe] = None
+        self._futures: Dict[int, Future] = {}
+        self._lock = threading.Lock()
+        self._next_op = 0
+        self._rank = 0
+        self._world_size = 1
+        self._errored: Optional[Exception] = None
+
+    # -- child lifecycle ----------------------------------------------------
+
+    def _spawn(self) -> None:
+        parent_cmd, child_cmd = self._ctx.Pipe()
+        child_out, parent_out = self._ctx.Pipe(duplex=False)
+        self._proc = self._ctx.Process(
+            target=_worker_main,
+            args=(child_cmd, parent_out, self._backend, self._timeout_s),
+            daemon=True,
+        )
+        self._proc.start()
+        child_cmd.close()
+        parent_out.close()
+        self._cmd = MonitoredPipe(parent_cmd)
+        out = MonitoredPipe(child_out)
+        threading.Thread(
+            target=self._listen,
+            args=(out, self._proc),
+            name="tpuft_baby_listener",
+            daemon=True,
+        ).start()
+
+    def _listen(self, out: MonitoredPipe, proc) -> None:
+        """Deliver results from the child to waiting futures
+        (``process_group.py:1697-1730``)."""
+        while True:
+            try:
+                op_id, result = out.recv(timeout=60.0)
+            except TimeoutError:
+                # idle pipe is NOT death — a healthy communicator can sit
+                # quiet between steps indefinitely
+                if proc.is_alive():
+                    continue
+                self._fail_all("baby communicator child died")
+                return
+            except (EOFError, OSError):
+                self._fail_all("baby communicator child died")
+                return
+            if op_id == -1:
+                # child init failure: surface the real cause everywhere
+                err = (
+                    result
+                    if isinstance(result, Exception)
+                    else RuntimeError(str(result))
+                )
+                self._errored = self._errored or err
+                self._fail_all(str(err))
+                return
+            with self._lock:
+                fut = self._futures.pop(op_id, None)
+            if fut is None:
+                continue
+            if isinstance(result, Exception):
+                self._errored = self._errored or result
+                fut.set_exception(result)
+            else:
+                fut.set_result(result)
+
+    def _fail_all(self, reason: str) -> None:
+        with self._lock:
+            futures = list(self._futures.values())
+            self._futures.clear()
+        for fut in futures:
+            if not fut.done():
+                fut.set_exception(CommunicatorAborted(reason))
+
+    def _submit(self, op: str, args: dict) -> Work:
+        with self._lock:
+            if self._errored is not None:
+                fut: Future = Future()
+                fut.set_exception(self._errored)
+                return Work(fut)
+            if self._cmd is None:
+                fut = Future()
+                fut.set_exception(CommunicatorError("not configured"))
+                return Work(fut)
+            op_id = self._next_op
+            self._next_op += 1
+            fut = Future()
+            self._futures[op_id] = fut
+            try:
+                self._cmd.send((op_id, op, args))
+            except (OSError, ValueError) as e:
+                self._futures.pop(op_id, None)
+                fut.set_exception(CommunicatorError(f"baby pipe send failed: {e}"))
+        return Work(fut)
+
+    # -- Communicator surface -----------------------------------------------
+
+    def configure(
+        self,
+        store_addr: str,
+        replica_id: str,
+        rank: int,
+        world_size: int,
+        quorum_id: int = 0,
+        group_rank: int = 0,
+        group_world_size: int = 1,
+        global_ranks: Sequence[int] = (),
+    ) -> None:
+        self.abort("superseded by reconfigure")
+        with self._lock:
+            self._errored = None
+        self._spawn()
+        self._rank = rank
+        self._world_size = world_size
+        work = self._submit(
+            "configure",
+            dict(store_addr=store_addr, replica_id=replica_id, rank=rank, world_size=world_size),
+        )
+        err = work.exception(timeout=self._timeout_s + 10.0)
+        if err is not None:
+            raise CommunicatorError(f"baby configure failed: {err}") from err
+
+    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._submit("allreduce", dict(buffers=buffers, op=op))
+
+    def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
+        return self._submit("broadcast", dict(buffers=buffers, root=root))
+
+    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
+        return self._submit("send_bytes", dict(data=data, dst=dst, tag=tag))
+
+    def recv_bytes(self, src: int, tag: int = 0) -> Work:
+        return self._submit("recv_bytes", dict(src=src, tag=tag))
+
+    def barrier(self) -> Work:
+        return self._submit("barrier", dict())
+
+    def abort(self, reason: str = "aborted") -> None:
+        """Kill the child — recovery even from wedges abort can't unblock."""
+        with self._lock:
+            proc, self._proc = self._proc, None
+            cmd, self._cmd = self._cmd, None
+            if self._errored is None and proc is not None:
+                self._errored = CommunicatorAborted(reason)
+            futures = list(self._futures.values())
+            self._futures.clear()
+        if cmd is not None:
+            cmd.close()
+        if proc is not None:
+            proc.terminate()
+            proc.join(timeout=5.0)
+            if proc.is_alive():
+                proc.kill()
+        for fut in futures:
+            if not fut.done():
+                fut.set_exception(CommunicatorAborted(reason))
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._world_size
+
+    def set_timeout(self, timeout_s: float) -> None:
+        self._timeout_s = timeout_s
+
+    def shutdown(self) -> None:
+        self.abort("shutdown")

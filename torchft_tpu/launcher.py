@@ -123,7 +123,12 @@ class ReplicaSupervisor:
                     self._restarts[gid],
                 )
                 time.sleep(self._restart_delay_s)
-                self._procs[gid] = self._spawn(spec)
+                if self._stop.is_set():
+                    break
+                with self._lock:
+                    # under the lock so stop()/kill() can never miss a
+                    # freshly respawned child
+                    self._procs[gid] = self._spawn(spec)
         return worst_rc
 
     def kill(self, replica_group_id: int, sig: int = signal.SIGKILL) -> bool:

@@ -305,10 +305,35 @@ class DiLoCo:
         avoid cross-replica deadlock (``local_sgd.py:745-763``)."""
         return self._manager.current_step() % len(self._fragments)
 
+    def pre_step(self):
+        """Guard the holder against concurrent checkpoint reads while the
+        inner optimizer mutates it (the reference's inner optimizer
+        pre-hook, ``local_sgd.py:716-720``).  Returns a context manager so
+        the lock is released even when the inner step raises::
+
+            with diloco.pre_step():
+                ...inner optimizer step...
+            diloco.step()
+        """
+        import contextlib
+
+        manager = self._manager
+
+        @contextlib.contextmanager
+        def _guard():
+            manager.disallow_state_dict_read()
+            try:
+                yield
+            finally:
+                manager.allow_state_dict_read()
+
+        return _guard()
+
     def step(self) -> Optional[bool]:
         """Call after every inner optimizer step (the reference's optimizer
         post-hook, ``local_sgd.py:745-795``); returns the commit decision on
         sync steps, None otherwise."""
+        self._manager.allow_state_dict_read()
         self._local_step += 1
 
         if self._local_step == self._sync_every - self._fragment_sync_delay:

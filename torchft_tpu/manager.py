@@ -267,6 +267,18 @@ class Manager:
         self._load_state_dict_fns[key] = cast(Callable[[object], None], load_state_dict)
         self._user_state_dicts[key] = state_dict
 
+    def disallow_state_dict_read(self) -> None:
+        """Block checkpoint serving while the train loop mutates state
+        (``manager.py:366-378``; used as the DiLoCo inner-step pre-hook)."""
+        if getattr(self, "_state_dict_write_guard", None) is None:
+            self._state_dict_write_guard = self._state_dict_lock.w_lock()
+
+    def allow_state_dict_read(self) -> None:
+        guard = getattr(self, "_state_dict_write_guard", None)
+        if guard is not None:
+            self._state_dict_write_guard = None
+            guard.__exit__(None, None, None)
+
     def _manager_state_dict(self) -> Dict[str, object]:
         with self._state_dict_lock.r_lock():
             return {
