@@ -1,0 +1,77 @@
+"""Expert-parallel MoE tests on the virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchft_tpu.parallel.mesh import make_mesh
+from torchft_tpu.parallel.moe import MoE, MoEConfig
+
+
+def _mesh_ep(n: int):
+    import numpy as np_
+
+    devices = np_.asarray(jax.devices()[:n])
+    from jax.sharding import Mesh
+
+    return Mesh(devices.reshape(n), ("ep",))
+
+
+class TestMoEDense:
+    def test_forward_shape_and_grad(self) -> None:
+        config = MoEConfig(dim=16, ffn_hidden=32, num_experts=4)
+        moe = MoE(config)
+        params = moe.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+        out = moe.apply(params, x)
+        assert out.shape == x.shape
+
+        def loss(p):
+            return jnp.sum(moe.apply(p, x) ** 2)
+
+        grads = jax.grad(loss)(params)
+        assert np.isfinite(np.asarray(grads["router"]).sum())
+        assert np.isfinite(np.asarray(grads["w_up"]).sum())
+
+    def test_routing_uses_multiple_experts(self) -> None:
+        config = MoEConfig(dim=16, ffn_hidden=32, num_experts=4, capacity_factor=2.0)
+        moe = MoE(config)
+        params = moe.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 64, 16))
+        logits = np.asarray(x.reshape(-1, 16) @ params["router"])
+        used = set(np.argmax(logits, axis=-1))
+        assert len(used) > 1
+
+
+class TestMoEExpertParallel:
+    def test_ep_matches_dense(self) -> None:
+        """Expert-parallel all_to_all path == dense reference (tokens and
+        experts both sharded over ep=4)."""
+        n_ep = 4
+        config = MoEConfig(dim=16, ffn_hidden=32, num_experts=8, capacity_factor=8.0)
+        mesh = _mesh_ep(n_ep)
+        moe_dense = MoE(config)
+        moe_ep = MoE(config, mesh=mesh, ep_axis="ep")
+        params = moe_dense.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 16))
+
+        dense_out = moe_dense.apply(params, x)
+
+        params_sh = jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+            params,
+            moe_ep.param_specs(),
+            is_leaf=lambda v: isinstance(v, P),
+        )
+        x_sh = jax.device_put(x, NamedSharding(mesh, P(None, "ep", None)))
+        with mesh:
+            ep_out = jax.jit(moe_ep.apply)(params_sh, x_sh)
+
+        # capacity differs between global (dense) and per-shard routing when
+        # tokens overflow; with a generous capacity_factor both keep all
+        # tokens and the math must agree
+        np.testing.assert_allclose(
+            np.asarray(ep_out), np.asarray(dense_out), rtol=2e-4, atol=2e-5
+        )
