@@ -13,7 +13,6 @@
 #pragma once
 
 #include <fcntl.h>
-#include <poll.h>
 
 #include <atomic>
 #include <chrono>
@@ -231,14 +230,16 @@ class Communicator {
     ::close(listen_fd);
 
     for (auto& [peer, fd] : fresh) {
-      int buf = 8 * 1024 * 1024;  // deep kernel buffers for throughput
-      ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
-      ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+      // NB: no explicit SO_SNDBUF/SO_RCVBUF — setting them disables the
+      // kernel's TCP buffer autotuning, which reaches larger effective
+      // windows than the rmem/wmem_max caps allow explicitly
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      // poll()-driven duplex loops require non-blocking IO
-      int fl = ::fcntl(fd, F_GETFL, 0);
-      ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+      // blocking IO with a short timeout quantum: throughput of plain
+      // send/recv, abort/deadline checks every quantum on EAGAIN
+      timeval tv{0, 200000};  // 200ms
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     }
     {
       std::lock_guard<std::mutex> lock(state_mu_);
@@ -349,11 +350,11 @@ class Communicator {
     auto deadline = deadline_in(timeout_s_);
     int fd = peer_fd(src);
     uint64_t hdr[2];
-    recv_deadline(fd, hdr, 16, deadline, src);
+    recv_loop(fd, src, hdr, 16, deadline);
     if (hdr[1] != tag)
       throw CommError("tag mismatch from rank " + std::to_string(src));
     std::vector<uint8_t> out(hdr[0]);
-    recv_deadline(fd, out.data(), out.size(), deadline, src);
+    recv_loop(fd, src, out.data(), out.size(), deadline);
     return out;
   }
 
@@ -419,20 +420,54 @@ class Communicator {
     if (aborted_) throw CommError("communicator aborted");
   }
 
-  void recv_deadline(int fd, void* buf, size_t n, TimePoint deadline,
-                     int64_t peer) {
+  // --- blocking framed IO with abort/deadline checks per quantum ---------
+
+  void send_framed(int fd, int64_t peer, uint64_t tag, const void* buf,
+                   size_t nbytes, TimePoint deadline) {
+    uint64_t hdr[2] = {nbytes, tag};
+    send_loop(fd, peer, hdr, 16, deadline);
+    send_loop(fd, peer, buf, nbytes, deadline);
+  }
+
+  void recv_framed(int fd, int64_t peer, uint64_t tag, void* buf,
+                   size_t nbytes, TimePoint deadline) {
+    uint64_t hdr[2];
+    recv_loop(fd, peer, hdr, 16, deadline);
+    if (hdr[1] != tag)
+      throw CommError("tag mismatch from rank " + std::to_string(peer));
+    if (hdr[0] != nbytes)
+      throw CommError("size mismatch from rank " + std::to_string(peer));
+    recv_loop(fd, peer, buf, nbytes, deadline);
+  }
+
+  void send_loop(int fd, int64_t peer, const void* buf, size_t n,
+                 TimePoint deadline) {
+    const uint8_t* p = static_cast<const uint8_t*>(buf);
+    while (n > 0) {
+      check_abort();
+      if (now() > deadline) throw CommError("send timed out");
+      ssize_t sent = ::send(fd, p, n, MSG_NOSIGNAL);
+      if (sent < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+          continue;  // quantum expired: re-check abort/deadline
+        throw CommError("send failed to rank " + std::to_string(peer));
+      }
+      p += sent;
+      n -= static_cast<size_t>(sent);
+    }
+  }
+
+  void recv_loop(int fd, int64_t peer, void* buf, size_t n, TimePoint deadline) {
     uint8_t* p = static_cast<uint8_t*>(buf);
     while (n > 0) {
       check_abort();
       if (now() > deadline) throw CommError("recv timed out");
-      pollfd pfd{fd, POLLIN, 0};
-      int ready = ::poll(&pfd, 1, 100);
-      if (ready <= 0) continue;
       ssize_t got = ::recv(fd, p, n, 0);
       if (got == 0)
         throw CommError("connection to rank " + std::to_string(peer) + " closed");
       if (got < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+          continue;  // quantum expired: re-check abort/deadline
         throw CommError("recv failed from rank " + std::to_string(peer));
       }
       p += got;
@@ -440,199 +475,80 @@ class Communicator {
     }
   }
 
-  // duplex single-pair exchange: optionally send (dst>=0) and/or receive
-  // (src>=0) one framed payload, progressing both directions concurrently.
+  // duplex single-pair exchange: a sender thread pushes while this thread
+  // receives — full socket throughput in both directions, deadlock-free
+  // even when both legs share one socket (ws == 2 rings).
   void exchange(int64_t dst, uint64_t send_tag, void* send_buf,
                 size_t send_bytes, int64_t src, uint64_t recv_tag,
                 void* recv_buf, size_t recv_bytes, TimePoint deadline) {
-    struct Dir {
-      int fd = -1;
-      uint8_t hdr[16];
-      size_t hdr_done = 0;
-      uint8_t* payload = nullptr;
-      size_t remaining = 0;
-      bool active = false;
-    };
-    Dir sd, rd;
-    if (dst >= 0) {
-      sd.fd = peer_fd(dst);
-      uint64_t h[2] = {send_bytes, send_tag};
-      std::memcpy(sd.hdr, h, 16);
-      sd.payload = static_cast<uint8_t*>(send_buf);
-      sd.remaining = send_bytes;
-      sd.active = true;
-    }
-    if (src >= 0) {
-      rd.fd = peer_fd(src);
-      rd.payload = static_cast<uint8_t*>(recv_buf);
-      rd.remaining = recv_bytes;
-      rd.active = true;
-    }
-
-    while (sd.active || rd.active) {
-      check_abort();
-      if (now() > deadline) throw CommError("exchange timed out");
-      pollfd pfds[2];
-      int n = 0;
-      int si = -1, ri = -1;
-      if (sd.active) {
-        si = n;
-        pfds[n++] = {sd.fd, POLLOUT, 0};
-      }
-      if (rd.active) {
-        ri = n;
-        pfds[n++] = {rd.fd, POLLIN, 0};
-      }
-      if (::poll(pfds, n, 100) <= 0) continue;
-
-      if (si >= 0 && (pfds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-        if (sd.hdr_done < 16) {
-          ssize_t sent = ::send(sd.fd, sd.hdr + sd.hdr_done, 16 - sd.hdr_done,
-                                MSG_NOSIGNAL);
-          if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
-            throw CommError("send failed to rank " + std::to_string(dst));
-          if (sent > 0) sd.hdr_done += static_cast<size_t>(sent);
-        } else if (sd.remaining > 0) {
-          ssize_t sent = ::send(sd.fd, sd.payload, sd.remaining, MSG_NOSIGNAL);
-          if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
-            throw CommError("send failed to rank " + std::to_string(dst));
-          if (sent > 0) {
-            sd.payload += sent;
-            sd.remaining -= static_cast<size_t>(sent);
-          }
+    if (dst >= 0 && src >= 0) {
+      int sfd = peer_fd(dst);
+      int rfd = peer_fd(src);
+      std::string send_err;
+      std::thread sender([&] {
+        try {
+          send_framed(sfd, dst, send_tag, send_buf, send_bytes, deadline);
+        } catch (const std::exception& e) {
+          send_err = e.what();
         }
-        if (sd.hdr_done == 16 && sd.remaining == 0) sd.active = false;
+      });
+      try {
+        recv_framed(rfd, src, recv_tag, recv_buf, recv_bytes, deadline);
+      } catch (...) {
+        sender.join();
+        throw;
       }
-
-      if (ri >= 0 && (pfds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
-        if (rd.hdr_done < 16) {
-          ssize_t got = ::recv(rd.fd, rd.hdr + rd.hdr_done, 16 - rd.hdr_done, 0);
-          if (got == 0)
-            throw CommError("connection to rank " + std::to_string(src) + " closed");
-          if (got < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
-            throw CommError("recv failed from rank " + std::to_string(src));
-          if (got > 0) rd.hdr_done += static_cast<size_t>(got);
-          if (rd.hdr_done == 16) {
-            uint64_t h[2];
-            std::memcpy(h, rd.hdr, 16);
-            if (h[1] != recv_tag)
-              throw CommError("tag mismatch from rank " + std::to_string(src));
-            if (h[0] != recv_bytes)
-              throw CommError("size mismatch from rank " + std::to_string(src));
-          }
-        } else if (rd.remaining > 0) {
-          ssize_t got = ::recv(rd.fd, rd.payload, rd.remaining, 0);
-          if (got == 0)
-            throw CommError("connection to rank " + std::to_string(src) + " closed");
-          if (got < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
-            throw CommError("recv failed from rank " + std::to_string(src));
-          if (got > 0) {
-            rd.payload += got;
-            rd.remaining -= static_cast<size_t>(got);
-          }
-        }
-        if (rd.hdr_done == 16 && rd.remaining == 0) rd.active = false;
-      }
+      sender.join();
+      if (!send_err.empty()) throw CommError(send_err);
+    } else if (dst >= 0) {
+      send_framed(peer_fd(dst), dst, send_tag, send_buf, send_bytes, deadline);
+    } else if (src >= 0) {
+      recv_framed(peer_fd(src), src, recv_tag, recv_buf, recv_bytes, deadline);
     }
   }
 
-  // all-peers concurrent exchange (alltoall/allgather)
+  // all-peers concurrent exchange (alltoall/allgather/broadcast fan-out):
+  // one duplex worker per peer.
   template <typename SendFn, typename RecvFn>
   void multi_exchange(const std::map<int64_t, int>& peers, SendFn send_for,
                       RecvFn recv_for, uint64_t tag, TimePoint deadline) {
-    struct State {
-      int fd;
-      uint8_t shdr[16];
-      size_t shdr_done = 0;
-      const uint8_t* sbuf;
-      size_t sbytes;
-      uint8_t rhdr[16];
-      size_t rhdr_done = 0;
-      uint8_t* rbuf;
-      size_t rbytes;
-      bool send_done = false, recv_done = false;
-      int64_t peer;
-    };
-    std::vector<State> states;
-    for (auto& [peer, fd] : peers) {
-      State st;
-      st.fd = fd;
-      st.peer = peer;
+    std::vector<std::thread> workers;
+    std::mutex err_mu;
+    std::string first_err;
+    for (const auto& [peer, fd] : peers) {
       auto [sb, sn] = send_for(peer);
       auto [rb, rn] = recv_for(peer);
-      uint64_t h[2] = {sn, tag};
-      std::memcpy(st.shdr, h, 16);
-      st.sbuf = sb;
-      st.sbytes = sn;
-      st.rbuf = rb;
-      st.rbytes = rn;
-      st.recv_done = (rb == nullptr);  // send-only leg (e.g. broadcast root)
-      states.push_back(st);
-    }
-
-    bool pending = !states.empty();
-    while (pending) {
-      check_abort();
-      if (now() > deadline) throw CommError("multi_exchange timed out");
-      std::vector<pollfd> pfds;
-      for (auto& st : states) {
-        short events = 0;
-        if (!st.send_done) events |= POLLOUT;
-        if (!st.recv_done) events |= POLLIN;
-        pfds.push_back({st.fd, events, 0});
-      }
-      if (::poll(pfds.data(), pfds.size(), 100) <= 0) continue;
-
-      pending = false;
-      for (size_t i = 0; i < states.size(); ++i) {
-        auto& st = states[i];
-        if (!st.send_done && (pfds[i].revents & (POLLOUT | POLLERR))) {
-          if (st.shdr_done < 16) {
-            ssize_t sent = ::send(st.fd, st.shdr + st.shdr_done,
-                                  16 - st.shdr_done, MSG_NOSIGNAL);
-            if (sent > 0) st.shdr_done += static_cast<size_t>(sent);
-            else if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
-              throw CommError("send failed to rank " + std::to_string(st.peer));
-          } else if (st.sbytes > 0) {
-            ssize_t sent = ::send(st.fd, st.sbuf, st.sbytes, MSG_NOSIGNAL);
-            if (sent > 0) {
-              st.sbuf += sent;
-              st.sbytes -= static_cast<size_t>(sent);
-            } else if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
-              throw CommError("send failed to rank " + std::to_string(st.peer));
+      workers.emplace_back([this, peer, fd, sb, sn, rb, rn, tag, deadline,
+                            &err_mu, &first_err] {
+        try {
+          if (rb == nullptr) {
+            send_framed(fd, peer, tag, sb, sn, deadline);
+            return;
           }
-          if (st.shdr_done == 16 && st.sbytes == 0) st.send_done = true;
-        }
-        if (!st.recv_done && (pfds[i].revents & (POLLIN | POLLERR | POLLHUP))) {
-          if (st.rhdr_done < 16) {
-            ssize_t got =
-                ::recv(st.fd, st.rhdr + st.rhdr_done, 16 - st.rhdr_done, 0);
-            if (got == 0)
-              throw CommError("connection to rank " + std::to_string(st.peer) + " closed");
-            if (got > 0) st.rhdr_done += static_cast<size_t>(got);
-            else if (got < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
-              throw CommError("recv failed from rank " + std::to_string(st.peer));
-            if (st.rhdr_done == 16) {
-              uint64_t h[2];
-              std::memcpy(h, st.rhdr, 16);
-              if (h[1] != tag || h[0] != st.rbytes)
-                throw CommError("frame mismatch from rank " + std::to_string(st.peer));
+          std::string send_err;
+          std::thread sender([&] {
+            try {
+              send_framed(fd, peer, tag, sb, sn, deadline);
+            } catch (const std::exception& e) {
+              send_err = e.what();
             }
-          } else if (st.rbytes > 0) {
-            ssize_t got = ::recv(st.fd, st.rbuf, st.rbytes, 0);
-            if (got == 0)
-              throw CommError("connection to rank " + std::to_string(st.peer) + " closed");
-            if (got > 0) {
-              st.rbuf += got;
-              st.rbytes -= static_cast<size_t>(got);
-            } else if (got < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
-              throw CommError("recv failed from rank " + std::to_string(st.peer));
+          });
+          try {
+            recv_framed(fd, peer, tag, rb, rn, deadline);
+          } catch (const std::exception& e) {
+            sender.join();
+            throw CommError(e.what());
           }
-          if (st.rhdr_done == 16 && st.rbytes == 0) st.recv_done = true;
+          sender.join();
+          if (!send_err.empty()) throw CommError(send_err);
+        } catch (const std::exception& e) {
+          std::lock_guard<std::mutex> lock(err_mu);
+          if (first_err.empty()) first_err = e.what();
         }
-        if (!st.send_done || !st.recv_done) pending = true;
-      }
+      });
     }
+    for (auto& w : workers) w.join();
+    if (!first_err.empty()) throw CommError(first_err);
   }
 
   double timeout_s_;
