@@ -297,6 +297,64 @@ def test_allreduce_failure_recovers(lighthouse) -> None:
     _assert_all_equal(states)
 
 
+def test_comm_transport_heal(lighthouse) -> None:
+    """Healing over the communicator fabric (CommTransport) instead of HTTP:
+    a fresh replica joins late and pulls live weights through send/recv on
+    the same communicator the gradients use."""
+    from torchft_tpu.checkpointing.comm_transport import CommTransport
+
+    class CommRunner(Runner):
+        def _replica_main(self) -> dict:
+            comm = TCPCommunicator(timeout_s=10.0)
+            params = _init_state()
+            tx = optax.sgd(0.05)
+            holder = {"params": params, "opt_state": tx.init(params)}
+            manager = Manager(
+                comm=comm,
+                load_state_dict=lambda s: holder.update(s),
+                state_dict=lambda: dict(holder),
+                min_replica_size=self.min_replicas,
+                replica_id=f"replica_{self.replica_idx}",
+                lighthouse_addr=self.lighthouse_addr,
+                timeout=10.0,
+                quorum_timeout=10.0,
+                checkpoint_transport=CommTransport(comm, timeout=10.0),
+            )
+            opt = OptimizerWrapper(manager, tx)
+            self._zombies.append(manager)
+            import time as _time
+
+            while manager.current_step() < self.num_steps:
+                self.injector.check(self, self.replica_idx, manager.current_step())
+                if self.step_time_s:
+                    _time.sleep(self.step_time_s)
+                opt.start_step()
+                grads = jax.tree_util.tree_map(
+                    lambda p: jnp.full_like(p, 0.01 * (self.replica_idx + 1)),
+                    holder["params"],
+                )
+                grads = ft_allreduce(manager, grads)
+                opt.step(holder, grads)
+            self.final_state = jax.tree_util.tree_map(np.asarray, dict(holder))
+            return self.final_state
+
+    injector = EventInjector()
+    injector.fail_at(replica=1, step=2)
+    runners = [
+        CommRunner(
+            i,
+            lighthouse.local_address(),
+            injector,
+            num_steps=10,
+            step_time_s=0.05,
+        )
+        for i in range(2)
+    ]
+    states = _run(runners)
+    assert injector.count == 1
+    _assert_all_equal(states)
+
+
 def test_three_replicas_one_kill(lighthouse) -> None:
     injector = EventInjector()
     injector.fail_at(replica=2, step=3)
