@@ -1,0 +1,121 @@
+"""Checkpoint transfer micro-benchmarks.
+
+Analogs of the reference harnesses
+(``torchft/checkpointing/http_transport_bench.py`` — 12 GB default workload —
+and ``pg_transport_bench.py``): measure live-heal transfer throughput for the
+HTTP transport and the communicator transport.
+
+    python benchmarks/checkpoint_bench.py --gb 1 --transport http
+    python benchmarks/checkpoint_bench.py --gb 1 --transport comm
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _state(total_bytes: int, chunk_mb: int = 64) -> dict:
+    n_chunks = max(1, total_bytes // (chunk_mb << 20))
+    per = total_bytes // n_chunks // 4
+    rng = np.random.default_rng(0)
+    return {
+        f"layer_{i}": rng.normal(size=per).astype(np.float32)
+        for i in range(n_chunks)
+    }
+
+
+def bench_http(total_bytes: int, num_chunks: int) -> float:
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    sender = HTTPTransport(timeout=300.0, num_chunks=num_chunks)
+    receiver = HTTPTransport(timeout=300.0, num_chunks=num_chunks)
+    state = _state(total_bytes)
+    try:
+        start = time.perf_counter()
+        sender.send_checkpoint([1], step=1, state_dict=state, timeout=300.0)
+        received = receiver.recv_checkpoint(
+            src_rank=0, metadata=sender.metadata(), step=1, timeout=300.0
+        )
+        elapsed = time.perf_counter() - start
+        assert received.keys() == state.keys()
+        return elapsed
+    finally:
+        sender.shutdown()
+        receiver.shutdown()
+
+
+def bench_comm(total_bytes: int, backend: str) -> float:
+    from torchft_tpu.checkpointing.comm_transport import CommTransport
+    from torchft_tpu.store import StoreServer
+
+    if backend == "cpp":
+        from torchft_tpu.native import CppCommunicator as Comm
+    else:
+        from torchft_tpu.communicator import TCPCommunicator as Comm
+
+    store = StoreServer("127.0.0.1:0")
+    state = _state(total_bytes)
+    times = {}
+
+    def _run(rank: int) -> None:
+        comm = Comm(timeout_s=300.0)
+        comm.configure(
+            f"127.0.0.1:{store.port}/bench",
+            replica_id=f"r{rank}",
+            rank=rank,
+            world_size=2,
+        )
+        transport = CommTransport(comm, timeout=300.0)
+        try:
+            start = time.perf_counter()
+            if rank == 0:
+                transport.send_checkpoint([1], step=1, state_dict=state, timeout=300.0)
+            else:
+                received = transport.recv_checkpoint(
+                    src_rank=0, metadata="<comm>", step=1, timeout=300.0
+                )
+                assert received.keys() == state.keys()
+            times[rank] = time.perf_counter() - start
+        finally:
+            comm.shutdown()
+
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            list(pool.map(_run, range(2)))
+        return max(times.values())
+    finally:
+        store.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gb", type=float, default=1.0)
+    parser.add_argument(
+        "--transport", choices=["http", "comm", "comm-cpp"], default="http"
+    )
+    parser.add_argument("--num-chunks", type=int, default=8)
+    args = parser.parse_args()
+    total = int(args.gb * (1 << 30))
+
+    if args.transport == "http":
+        elapsed = bench_http(total, args.num_chunks)
+    elif args.transport == "comm":
+        elapsed = bench_comm(total, "tcp")
+    else:
+        elapsed = bench_comm(total, "cpp")
+    print(
+        f"{args.transport}: {args.gb:.1f} GB in {elapsed:.2f}s "
+        f"= {total / elapsed / 1e9:.2f} GB/s"
+    )
+
+
+if __name__ == "__main__":
+    main()

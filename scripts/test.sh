@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# CI entry point (reference analog: scripts/test.sh running pytest + cargo):
+# build the native runtime, then run the full Python suite against it.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+make -C native
+python -m pytest tests/ -q "$@"
