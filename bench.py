@@ -27,10 +27,33 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _probe_backend(timeout_s: float = 180.0) -> bool:
+    """Check (in a subprocess, so a wedged TPU tunnel can't hang us) that
+    the default jax backend can actually initialize."""
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     platform = os.environ.get("TPUFT_BENCH_PLATFORM")
     if platform:
         jax.config.update("jax_platforms", platform)
+    elif not _probe_backend():
+        print(
+            "bench: default backend failed to initialize (wedged TPU tunnel?); "
+            "falling back to cpu",
+            file=sys.stderr,
+        )
+        jax.config.update("jax_platforms", "cpu")
     # persistent compile cache: bench reruns skip the slow first compile
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
