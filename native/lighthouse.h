@@ -230,6 +230,16 @@ class LighthouseServer {
 
   void handle(int conn) {
     try {
+      // protocol sniff: HTTP (dashboard) vs framed RPC on one port
+      char head[4] = {0};
+      ssize_t peeked = ::recv(conn, head, 4, MSG_PEEK);
+      if (peeked >= 3 && (std::memcmp(head, "GET", 3) == 0 ||
+                          std::memcmp(head, "POS", 3) == 0 ||
+                          std::memcmp(head, "HEA", 3) == 0)) {
+        handle_http(conn);
+        ::close(conn);
+        return;
+      }
       while (true) {
         auto [type, body] = recv_frame(conn);
         Reader r(body.data(), body.size());
@@ -312,13 +322,94 @@ class LighthouseServer {
     send_frame(conn, LH_QUORUM_RESP, w);
   }
 
+  void handle_http(int conn) {
+    set_recv_timeout(conn, 5.0);
+    std::string req;
+    char buf[2048];
+    while (req.find("\r\n\r\n") == std::string::npos) {
+      ssize_t got = ::recv(conn, buf, sizeof(buf), 0);
+      if (got <= 0) return;
+      req.append(buf, static_cast<size_t>(got));
+      if (req.size() > 1 << 20) return;
+    }
+    std::string path = "/";
+    auto sp1 = req.find(' ');
+    if (sp1 != std::string::npos) {
+      auto sp2 = req.find(' ', sp1 + 1);
+      if (sp2 != std::string::npos) path = req.substr(sp1 + 1, sp2 - sp1 - 1);
+    }
+
+    std::string body;
+    std::string ctype = "application/json";
+    std::string status = "200 OK";
+    const std::string kill_prefix = "/replica/";
+    const std::string kill_suffix = "/kill";
+    if (path.rfind(kill_prefix, 0) == 0 &&
+        path.size() > kill_prefix.size() + kill_suffix.size() &&
+        path.compare(path.size() - kill_suffix.size(), kill_suffix.size(),
+                     kill_suffix) == 0) {
+      std::string rid = path.substr(
+          kill_prefix.size(),
+          path.size() - kill_prefix.size() - kill_suffix.size());
+      bool ok = kill_replica(rid);
+      body = std::string("{\"ok\": ") + (ok ? "true" : "false") + "}";
+      if (!ok) status = "404 Not Found";
+    } else if (path == "/status.json" || path == "/status" || path == "/") {
+      body = status_json();
+    } else {
+      status = "404 Not Found";
+      body = "{\"error\": \"unknown path\"}";
+    }
+    std::string resp = "HTTP/1.1 " + status +
+                       "\r\nContent-Type: " + ctype +
+                       "\r\nContent-Length: " + std::to_string(body.size()) +
+                       "\r\nConnection: close\r\n\r\n" + body;
+    send_all(conn, resp.data(), resp.size());
+  }
+
+  bool kill_replica(const std::string& rid) {
+    std::string addr;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (!state_.has_prev) return false;
+      for (const auto& m : state_.prev_quorum.participants)
+        if (m.replica_id == rid) addr = m.address;
+    }
+    if (addr.empty()) return false;
+    try {
+      int fd = dial(addr, 10.0);
+      Writer w;
+      w.str("killed from dashboard");
+      send_frame(fd, MGR_KILL_REQ, w);
+      ::close(fd);
+      return true;
+    } catch (const std::exception&) {
+      return false;
+    }
+  }
+
   std::string status_json() {
     std::lock_guard<std::mutex> lock(mu_);
+    std::string parts = "[";
+    if (state_.has_prev) {
+      bool first = true;
+      for (const auto& m : state_.prev_quorum.participants) {
+        if (!first) parts += ", ";
+        first = false;
+        parts += "{\"replica_id\": \"" + m.replica_id +
+                 "\", \"address\": \"" + m.address +
+                 "\", \"store_address\": \"" + m.store_address +
+                 "\", \"step\": " + std::to_string(m.step) +
+                 ", \"world_size\": " + std::to_string(m.world_size) + "}";
+      }
+    }
+    parts += "]";
     std::string out = "{\"quorum_id\": " + std::to_string(state_.quorum_id) +
                       ", \"num_participants\": " +
                       (state_.has_prev
                            ? std::to_string(state_.prev_quorum.participants.size())
                            : "-1") +
+                      ", \"participants\": " + parts +
                       ", \"impl\": \"cpp\"}";
     return out;
   }

@@ -116,6 +116,38 @@ class TestCppLighthouse:
         finally:
             server.shutdown()
 
+    def test_http_dashboard_and_kill(self) -> None:
+        """C++ lighthouse serves the HTTP dashboard + kill on the RPC port
+        (parity with the Python server), compatible with punisher."""
+        import json
+        import urllib.request
+
+        server = native.CppLighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=50, quorum_tick_ms=20
+        )
+        try:
+            client = LighthouseClient(server.local_address(), connect_timeout=5.0)
+            client.quorum(replica_id="dash", timeout=5.0, step=4, address="vm:1")
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/status.json", timeout=5.0
+            ) as resp:
+                status = json.loads(resp.read())
+            assert status["impl"] == "cpp"
+            assert status["quorum_id"] == 1
+            assert status["participants"][0]["replica_id"] == "dash"
+            assert status["participants"][0]["step"] == 4
+            # kill of an unknown replica → 404
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/replica/ghost/kill",
+                    timeout=5.0,
+                )
+            client.close()
+        finally:
+            server.shutdown()
+
     def test_timeout_honored(self) -> None:
         server = native.CppLighthouseServer(
             bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=60000

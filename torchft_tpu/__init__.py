@@ -33,9 +33,40 @@ swap the host-side communicator and never trigger recompilation.
 __version__ = "0.1.0"
 
 _LAZY = {
+    # FT state machine + train-loop API
     "Manager": ("torchft_tpu.manager", "Manager"),
     "WorldSizeMode": ("torchft_tpu.manager", "WorldSizeMode"),
     "OptimizerWrapper": ("torchft_tpu.optim", "OptimizerWrapper"),
+    "ft_allreduce": ("torchft_tpu.ddp", "ft_allreduce"),
+    "allreduce_pytree": ("torchft_tpu.ddp", "allreduce_pytree"),
+    "DistributedDataParallel": ("torchft_tpu.ddp", "DistributedDataParallel"),
+    "DistributedSampler": ("torchft_tpu.data", "DistributedSampler"),
+    "LocalSGD": ("torchft_tpu.local_sgd", "LocalSGD"),
+    "DiLoCo": ("torchft_tpu.local_sgd", "DiLoCo"),
+    # data plane
+    "Communicator": ("torchft_tpu.communicator", "Communicator"),
+    "TCPCommunicator": ("torchft_tpu.communicator", "TCPCommunicator"),
+    "DummyCommunicator": ("torchft_tpu.communicator", "DummyCommunicator"),
+    "ManagedCommunicator": ("torchft_tpu.communicator", "ManagedCommunicator"),
+    "BabyCommunicator": ("torchft_tpu.baby", "BabyCommunicator"),
+    "CppCommunicator": ("torchft_tpu.native", "CppCommunicator"),
+    "ReduceOp": ("torchft_tpu.communicator", "ReduceOp"),
+    # control plane
+    "LighthouseServer": ("torchft_tpu.lighthouse", "LighthouseServer"),
+    "LighthouseClient": ("torchft_tpu.lighthouse", "LighthouseClient"),
+    "ManagerServer": ("torchft_tpu.manager_server", "ManagerServer"),
+    "ManagerClient": ("torchft_tpu.manager_server", "ManagerClient"),
+    # checkpointing
+    "CheckpointTransport": ("torchft_tpu.checkpointing.transport", "CheckpointTransport"),
+    "HTTPTransport": ("torchft_tpu.checkpointing.http_transport", "HTTPTransport"),
+    "CommTransport": ("torchft_tpu.checkpointing.comm_transport", "CommTransport"),
+    # parallelism
+    "make_mesh": ("torchft_tpu.parallel.mesh", "make_mesh"),
+    "HSDPTrainer": ("torchft_tpu.parallel.hsdp", "HSDPTrainer"),
+    "ring_attention_sharded": (
+        "torchft_tpu.parallel.ring_attention",
+        "ring_attention_sharded",
+    ),
 }
 
 __all__ = list(_LAZY)
