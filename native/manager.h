@@ -295,18 +295,19 @@ class ManagerServer {
     bool ok = false;
     Quorum quorum;
     std::string last_err = "unknown";
+    // persistent lighthouse connection across rounds (reference keeps a
+    // tonic channel, src/manager.rs:250-306); serialized by lh_fd_mu_
+    std::lock_guard<std::mutex> fd_lock(lh_fd_mu_);
     for (int64_t attempt = 0; attempt <= quorum_retries_; ++attempt) {
-      int fd = -1;
       try {
-        fd = dial(lighthouse_addr_, connect_timeout_s_);
+        if (lh_fd_ < 0) lh_fd_ = dial(lighthouse_addr_, connect_timeout_s_);
+        int fd = lh_fd_;
         Writer w;
         requester.encode(w);
         w.u64(static_cast<uint64_t>(timeout_s * 1000));
         set_recv_timeout(fd, timeout_s + 5.0);
         send_frame(fd, LH_QUORUM_REQ, w);
         auto [type, body] = recv_frame(fd);
-        ::close(fd);
-        fd = -1;
         if (type == ERROR_FRAME) {
           Reader r(body.data(), body.size());
           ErrCode code = static_cast<ErrCode>(r.u8());
@@ -317,7 +318,10 @@ class ManagerServer {
         ok = true;
         break;
       } catch (const std::exception& e) {
-        if (fd >= 0) ::close(fd);
+        if (lh_fd_ >= 0) {
+          ::close(lh_fd_);
+          lh_fd_ = -1;
+        }
         last_err = e.what();
         if (attempt < quorum_retries_) {
           double sleep_s =
@@ -415,6 +419,8 @@ class ManagerServer {
   uint64_t commit_gen_ = 0;
   bool commit_decision_ = false;
   ConnRegistry conns_;
+  std::mutex lh_fd_mu_;
+  int lh_fd_ = -1;
 };
 
 }  // namespace tpuft

@@ -297,6 +297,91 @@ def test_allreduce_failure_recovers(lighthouse) -> None:
     _assert_all_equal(states)
 
 
+def test_upscale_late_joiner(lighthouse) -> None:
+    """Elastic membership growth: a third replica joins mid-run, heals to
+    the quorum's max step, and all three converge
+    (``local_sgd_integ_test.py`` upscale via barrier_at analog)."""
+    import time as _time
+
+    injector = EventInjector()
+    runners = [
+        Runner(
+            i,
+            lighthouse.local_address(),
+            injector,
+            num_steps=12,
+            step_time_s=0.05,
+        )
+        for i in range(3)
+    ]
+
+    with ThreadPoolExecutor(max_workers=3) as pool:
+        futures = [pool.submit(runners[i].run_replica) for i in range(2)]
+        _time.sleep(1.0)  # replicas 0/1 make progress first
+        futures.append(pool.submit(runners[2].run_replica))
+        states = [f.result(timeout=120.0) for f in futures]
+    for r in runners:
+        r.cleanup()
+    _assert_all_equal(states)
+
+
+def test_fixed_with_spares_integration(lighthouse) -> None:
+    """FIXED_WITH_SPARES: three replicas, min_replica_size=2 — the divisor
+    stays 2 and the spare contributes zero gradients; states stay equal."""
+    from torchft_tpu.manager import WorldSizeMode
+
+    class SparesRunner(Runner):
+        def _replica_main(self) -> dict:
+            comm = TCPCommunicator(timeout_s=10.0)
+            params = _init_state()
+            tx = optax.sgd(0.05)
+            holder = {"params": params, "opt_state": tx.init(params)}
+            manager = Manager(
+                comm=comm,
+                load_state_dict=lambda s: holder.update(s),
+                state_dict=lambda: dict(holder),
+                min_replica_size=2,
+                world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
+                replica_id=f"replica_{self.replica_idx}",
+                lighthouse_addr=self.lighthouse_addr,
+                timeout=10.0,
+                quorum_timeout=10.0,
+            )
+            opt = OptimizerWrapper(manager, tx)
+            self._zombies.append(manager)
+            import time as _time
+
+            participant_counts = []
+            while manager.current_step() < self.num_steps:
+                if self.step_time_s:
+                    _time.sleep(self.step_time_s)
+                opt.start_step()
+                grads = jax.tree_util.tree_map(
+                    lambda p: jnp.full_like(p, 0.01), holder["params"]
+                )
+                grads = ft_allreduce(manager, grads)
+                participant_counts.append(manager.num_participants())
+                opt.step(holder, grads)
+            assert all(c == 2 for c in participant_counts), participant_counts
+            self.final_state = jax.tree_util.tree_map(np.asarray, dict(holder))
+            return self.final_state
+
+    injector = EventInjector()
+    runners = [
+        SparesRunner(
+            i,
+            lighthouse.local_address(),
+            injector,
+            num_steps=6,
+            min_replicas=2,
+            step_time_s=0.02,
+        )
+        for i in range(3)
+    ]
+    states = _run(runners)
+    _assert_all_equal(states)
+
+
 def test_comm_transport_heal(lighthouse) -> None:
     """Healing over the communicator fabric (CommTransport) instead of HTTP:
     a fresh replica joins late and pulls live weights through send/recv on

@@ -15,6 +15,10 @@ T = TypeVar("T")
 
 
 class CheckpointTransport(ABC, Generic[T]):
+    """Live peer-to-peer checkpoint channel: serve the current state dict
+    to recovering replicas and fetch a peer's when healing
+    (``torchft/checkpointing/transport.py:14-68``)."""
+
     @abstractmethod
     def metadata(self) -> str:
         """Opaque metadata handed to recovering peers (e.g. a URL)."""

@@ -54,6 +54,9 @@ Buffers = Union[np.ndarray, Sequence[np.ndarray]]
 
 
 class ReduceOp(Enum):
+    """Reduction for collectives; AVG divides the SUM by the communicator
+    world size (the Manager instead divides by live participants)."""
+
     SUM = "sum"
     AVG = "avg"
     MAX = "max"

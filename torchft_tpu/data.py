@@ -20,6 +20,11 @@ import numpy as np
 
 
 class DistributedSampler:
+    """Shards a dataset across replica groups and their workers; this
+    worker reads shard ``group_rank + num_workers * replica_rank``
+    (``torchft/data.py:24-77`` semantics, documented-lossy on membership
+    change)."""
+
     def __init__(
         self,
         dataset_len: int,

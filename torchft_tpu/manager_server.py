@@ -177,6 +177,11 @@ class ManagerServer:
         self._commit_decision = False
 
         self._shutdown = False
+        # persistent lighthouse connection for quorum forwarding; rounds are
+        # normally sequential, but a timed-out round can overlap the next,
+        # so serialize access
+        self._lh_quorum_client: Optional[LighthouseClient] = None
+        self._lh_client_lock = threading.Lock()
 
         host, port = bind.rsplit(":", 1)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -212,6 +217,15 @@ class ManagerServer:
             pass
         with self._lock:
             self._lock.notify_all()
+        # best-effort: an in-flight quorum RPC may hold the lock until its
+        # deadline; don't block shutdown on it (threads are daemonized)
+        if self._lh_client_lock.acquire(timeout=1.0):
+            try:
+                if self._lh_quorum_client is not None:
+                    self._lh_quorum_client.close()
+                    self._lh_quorum_client = None
+            finally:
+                self._lh_client_lock.release()
 
     @staticmethod
     def _default_kill(msg: str) -> None:
@@ -390,12 +404,15 @@ class ManagerServer:
         quorum: Optional[Quorum] = None
         last_err = "unknown"
         for attempt in range(self._quorum_retries + 1):
-            client: Optional[LighthouseClient] = None
             try:
-                client = LighthouseClient(
-                    self._lighthouse_addr, connect_timeout=self._connect_timeout
-                )
-                quorum = client.quorum(
+              with self._lh_client_lock:
+                # persistent connection across rounds (the reference keeps a
+                # tonic channel, src/manager.rs:250-306); recreated on failure
+                if self._lh_quorum_client is None:
+                    self._lh_quorum_client = LighthouseClient(
+                        self._lighthouse_addr, connect_timeout=self._connect_timeout
+                    )
+                quorum = self._lh_quorum_client.quorum(
                     replica_id=requester.replica_id,
                     timeout=timeout_s,
                     address=requester.address,
@@ -414,15 +431,15 @@ class ManagerServer:
                     attempt,
                     e,
                 )
+                if self._lh_quorum_client is not None:
+                    self._lh_quorum_client.close()
+                    self._lh_quorum_client = None
                 if attempt < self._quorum_retries:
                     # only back off when another attempt remains — otherwise
                     # broadcast the failure to parked ranks immediately
                     time.sleep(
                         max(0.1, timeout_s / max(self._quorum_retries + 1, 1))
                     )
-            finally:
-                if client is not None:
-                    client.close()
 
         with self._lock:
             self._latest = quorum
