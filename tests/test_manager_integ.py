@@ -309,15 +309,24 @@ def test_upscale_late_joiner(lighthouse) -> None:
             i,
             lighthouse.local_address(),
             injector,
-            num_steps=12,
+            num_steps=30,
             step_time_s=0.05,
         )
         for i in range(3)
     ]
 
+    def _progressed() -> bool:
+        return any(
+            m.current_step() >= 2 for r in runners[:2] for m in r._zombies
+        )
+
     with ThreadPoolExecutor(max_workers=3) as pool:
         futures = [pool.submit(runners[i].run_replica) for i in range(2)]
-        _time.sleep(1.0)  # replicas 0/1 make progress first
+        # start the joiner only once the first two demonstrably progressed
+        deadline = _time.monotonic() + 60.0
+        while not _progressed() and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        assert _progressed(), "early replicas made no progress"
         futures.append(pool.submit(runners[2].run_replica))
         states = [f.result(timeout=120.0) for f in futures]
     for r in runners:
