@@ -320,17 +320,24 @@ def test_upscale_late_joiner(lighthouse) -> None:
             m.current_step() >= 2 for r in runners[:2] for m in r._zombies
         )
 
-    with ThreadPoolExecutor(max_workers=3) as pool:
-        futures = [pool.submit(runners[i].run_replica) for i in range(2)]
-        # start the joiner only once the first two demonstrably progressed
-        deadline = _time.monotonic() + 60.0
-        while not _progressed() and _time.monotonic() < deadline:
-            _time.sleep(0.05)
-        assert _progressed(), "early replicas made no progress"
-        futures.append(pool.submit(runners[2].run_replica))
-        states = [f.result(timeout=120.0) for f in futures]
-    for r in runners:
-        r.cleanup()
+    try:
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            futures = [pool.submit(runners[i].run_replica) for i in range(2)]
+            # start the joiner only once the first two demonstrably progressed
+            deadline = _time.monotonic() + 60.0
+            while not _progressed() and _time.monotonic() < deadline:
+                for f in futures:
+                    if f.done():
+                        f.result()  # surface a crashed replica's real error
+                _time.sleep(0.05)
+            assert _progressed(), "early replicas made no progress"
+            futures.append(pool.submit(runners[2].run_replica))
+            states = [f.result(timeout=120.0) for f in futures]
+    finally:
+        # shut managers down even on the failure path, or executor shutdown
+        # hangs on still-running replica loops
+        for r in runners:
+            r.cleanup()
     _assert_all_equal(states)
 
 
