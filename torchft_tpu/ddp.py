@@ -11,6 +11,9 @@ shardings.  Compiled programs never see the replica count (SURVEY.md §7).
 
 from __future__ import annotations
 
+import os
+import threading
+from concurrent.futures import Future
 from typing import Any, Dict, List, Tuple
 
 import jax
@@ -19,6 +22,33 @@ import numpy as np
 
 from torchft_tpu.manager import Manager
 from torchft_tpu.work import DummyWork, Work
+
+# Split gradient buckets at this size (reference: TORCHFT_USE_BUCKETIZATION /
+# bucket_cap_mb, ``local_sgd.py:28``); pipelines D2H transfer with the rings.
+# MUST be uniform across replicas: bucket boundaries shape the collective
+# sequence (mismatches fail fast via the ring's frame-size validation, like
+# the reference's frozen DDP bucket layout requirement, ``ddp.py:46-62``).
+# Parsed once at import so it cannot drift within a process; malformed values
+# fall back to the default rather than raising into the train loop.
+BUCKET_CAP_MB_ENV = "TORCHFT_BUCKET_CAP_MB"
+DEFAULT_BUCKET_CAP_MB = 32
+
+
+def _parse_bucket_cap() -> int:
+    raw = os.environ.get(BUCKET_CAP_MB_ENV, "")
+    try:
+        mb = float(raw) if raw else float(DEFAULT_BUCKET_CAP_MB)
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "invalid %s=%r; using %d MB", BUCKET_CAP_MB_ENV, raw, DEFAULT_BUCKET_CAP_MB
+        )
+        mb = float(DEFAULT_BUCKET_CAP_MB)
+    return max(1, int(mb * (1 << 20)))
+
+
+_BUCKET_CAP_BYTES = _parse_bucket_cap()
 
 
 def allreduce_pytree_result(tree: Any) -> Work:
@@ -57,35 +87,69 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
 
     original = list(leaves)
 
-    # bucket by dtype so each dtype rides one ring (DDP-style flat buckets)
-    host: List[np.ndarray] = [_to_host(leaf) for leaf in leaves]
+    # Kick off every device→host transfer asynchronously up front so DMA
+    # overlaps the bucket assembly and the first ring.
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            try:
+                leaf.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+
+    # Bucket by dtype (each dtype needs its own ring), then split large
+    # buckets at ``bucket_cap`` bytes and submit each as its own collective:
+    # the op thread rings bucket k while we fetch/assemble bucket k+1 —
+    # transfer/communication pipelining, the reference's bucket_cap_mb
+    # (``local_sgd.py:28,477-566``) in jax form.
+    bucket_cap = _BUCKET_CAP_BYTES
     order: Dict[str, List[int]] = {}
-    for i, arr in enumerate(host):
-        order.setdefault(arr.dtype.name, []).append(i)
+    leaf_bytes: List[int] = []
+    for i, leaf in enumerate(leaves):
+        if hasattr(leaf, "dtype") and hasattr(leaf, "nbytes"):
+            dtype_name, nbytes = leaf.dtype.name, int(leaf.nbytes)
+        else:
+            arr = np.asarray(leaf)
+            dtype_name, nbytes = arr.dtype.name, int(arr.nbytes)
+        leaf_bytes.append(nbytes)
+        order.setdefault(dtype_name, []).append(i)
 
-    buckets: List[np.ndarray] = []
-    bucket_layout: List[List[Tuple[int, int, int, tuple]]] = []
+    works: List[Work] = []
+    bucket_layouts: List[List[Tuple[int, int, int, tuple]]] = []
     for dtype_name, idxs in order.items():
-        total = sum(host[i].size for i in idxs)
-        flat = np.empty(total, dtype=host[idxs[0]].dtype)
-        layout = []
-        off = 0
+        group: List[int] = []
+        group_bytes = 0
+        groups: List[List[int]] = []
         for i in idxs:
-            n = host[i].size
-            flat[off : off + n] = host[i].reshape(-1)
-            layout.append((i, off, n, host[i].shape))
-            off += n
-        buckets.append(flat)
-        bucket_layout.append(layout)
+            if group and group_bytes + leaf_bytes[i] > bucket_cap:
+                groups.append(group)
+                group, group_bytes = [], 0
+            group.append(i)
+            group_bytes += leaf_bytes[i]
+        if group:
+            groups.append(group)
 
-    work = manager.allreduce(buckets, should_quantize=should_quantize)
+        for group in groups:
+            host = [_to_host(leaves[i]) for i in group]  # waits async copies
+            total = sum(a.size for a in host)
+            flat = np.empty(total, dtype=host[0].dtype)
+            layout = []
+            off = 0
+            for i, arr in zip(group, host):
+                n = arr.size
+                flat[off : off + n] = arr.reshape(-1)
+                layout.append((i, off, n, arr.shape))
+                off += n
+            # submit immediately: this bucket's ring overlaps the next
+            # bucket's fetch/assembly
+            works.append(
+                manager.allreduce(flat, should_quantize=should_quantize)
+            )
+            bucket_layouts.append(layout)
 
-    def _unbucket(reduced: Any) -> Any:
-        arrays: List[np.ndarray] = (
-            reduced if isinstance(reduced, list) else [reduced]
-        )
+    def _gather() -> Any:
         out = list(original)
-        for flat, layout in zip(arrays, bucket_layout):
+        for work, layout in zip(works, bucket_layouts):
+            flat = work.wait()
             for i, off, n, shape in layout:
                 host_val = flat[off : off + n].reshape(shape)
                 leaf = original[i]
@@ -98,7 +162,19 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
                     out[i] = host_val
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    return work.then(_unbucket)
+    fut: "Future[Any]" = Future()
+
+    def _finish() -> None:
+        try:
+            fut.set_result(_gather())
+        except Exception as e:  # noqa: BLE001 — funnel, never raise
+            manager.report_error(e)
+            fut.set_result(jax.tree_util.tree_unflatten(treedef, original))
+
+    threading.Thread(
+        target=_finish, name="tpuft_ddp_gather", daemon=True
+    ).start()
+    return Work(fut)
 
 
 @jax.jit
