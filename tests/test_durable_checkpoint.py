@@ -1,0 +1,73 @@
+"""Durable checkpoint utility + watchdog tests."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchft_tpu.utils.checkpoint import (
+    latest_step,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+class TestDurableCheckpoint:
+    def test_roundtrip_and_latest(self, tmp_path) -> None:
+        base = str(tmp_path / "ckpt")
+        assert latest_step(base) is None
+        state = {
+            "model": {"w": jnp.arange(6, dtype=jnp.float32)},
+            "torchft": {"step": 5, "batches_committed": 10},
+        }
+        save_checkpoint(base, 5, state)
+        assert latest_step(base) == 5
+        restored = load_checkpoint(base, 5)
+        np.testing.assert_array_equal(restored["model"]["w"], np.arange(6))
+        assert restored["torchft"] == {"step": 5, "batches_committed": 10}
+
+    def test_prunes_old_steps(self, tmp_path) -> None:
+        base = str(tmp_path / "ckpt")
+        for step in range(6):
+            save_checkpoint(base, step, {"s": step}, keep=3)
+        assert latest_step(base) == 5
+        steps = sorted(
+            int(d.split("_")[1]) for d in os.listdir(base) if d.startswith("step_")
+        )
+        assert steps == [3, 4, 5]
+
+    def test_overwrite_same_step(self, tmp_path) -> None:
+        base = str(tmp_path / "ckpt")
+        save_checkpoint(base, 1, {"v": 1})
+        save_checkpoint(base, 1, {"v": 2})
+        assert load_checkpoint(base, 1) == {"v": 2}
+
+
+def test_watchdog_exits_on_wedged_timer(tmp_path) -> None:
+    """The watchdog hard-exits a process whose timeout engine is wedged
+    (reference: ``futures_test.py:102`` with a mocked sys.exit)."""
+    import subprocess
+    import sys
+
+    script = """
+import os, threading, time
+os.environ["TORCHFT_WATCHDOG_TIMEOUT_SEC"] = "1"
+from torchft_tpu import futures
+
+# wedge the timer thread: a callback that never returns
+futures.schedule_timeout(0.01, lambda: time.sleep(3600))
+time.sleep(0.2)
+# a pending deadline that the wedged thread can never service
+futures.schedule_timeout(0.05, lambda: None)
+time.sleep(10)
+print("SHOULD NOT PRINT")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        timeout=30,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 1
+    assert b"SHOULD NOT PRINT" not in proc.stdout

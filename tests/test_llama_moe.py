@@ -1,0 +1,77 @@
+"""LlamaMoE tests: forward/loss/causality, training, and expert-parallel
+equivalence over an ep mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchft_tpu.models.llama_moe import LlamaMoE, llama_moe_debug
+
+
+def _batch(config, batch=2, seq=32, seed=0):
+    key = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(key, (batch, seq), 0, config.vocab_size)
+    return tokens, jnp.roll(tokens, -1, axis=1)
+
+
+class TestLlamaMoE:
+    def test_forward_and_loss(self) -> None:
+        config = llama_moe_debug()
+        model = LlamaMoE(config)
+        params = model.init(jax.random.PRNGKey(0))
+        tokens, targets = _batch(config)
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 32, config.vocab_size)
+        loss = float(model.loss(params, (tokens, targets)))
+        assert abs(loss - np.log(config.vocab_size)) < 1.5
+
+    def test_num_params_matches(self) -> None:
+        config = llama_moe_debug()
+        model = LlamaMoE(config)
+        params = model.init(jax.random.PRNGKey(0))
+        actual = sum(np.asarray(l).size for l in jax.tree_util.tree_leaves(params))
+        assert actual == model.num_params()
+
+    def test_training_reduces_loss(self) -> None:
+        config = llama_moe_debug()
+        model = LlamaMoE(config)
+        params = model.init(jax.random.PRNGKey(0))
+        batch = _batch(config)
+        tx = optax.adam(2e-3)
+        opt_state = tx.init(params)
+        step = jax.jit(jax.value_and_grad(model.loss))
+        first = None
+        for _ in range(6):
+            loss, grads = step(params, batch)
+            if first is None:
+                first = float(loss)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        assert float(loss) < first
+
+    def test_expert_parallel_matches_dense(self) -> None:
+        n_ep = 4
+        devices = np.asarray(jax.devices()[:n_ep])
+        # the backbone's megatron specs reference fsdp/tp; give them
+        # singleton axes alongside the real ep axis
+        mesh = Mesh(devices.reshape(1, 1, n_ep), ("fsdp", "tp", "ep"))
+        config = llama_moe_debug()
+        dense = LlamaMoE(config)
+        ep_model = LlamaMoE(config, mesh=mesh)
+        params = dense.init(jax.random.PRNGKey(0))
+        tokens, targets = _batch(config, batch=1, seq=32)
+        ref = float(dense.loss(params, (tokens, targets)))
+
+        params_sh = jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+            params,
+            ep_model.param_specs(),
+            is_leaf=lambda v: isinstance(v, P),
+        )
+        with mesh:
+            ep_loss = float(jax.jit(ep_model.loss)(params_sh, (tokens, targets)))
+        # per-shard capacity truncation differs from global routing only when
+        # tokens overflow; the debug capacity_factor keeps everything
+        assert abs(ep_loss - ref) < 2e-3

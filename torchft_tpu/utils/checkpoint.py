@@ -1,0 +1,87 @@
+"""Durable (disk) checkpointing for fault-tolerant jobs.
+
+Live peer-to-peer healing covers *replica* loss; durable checkpoints cover
+*job* loss, and per the reference's doctrine they must include the Manager's
+own state so step counts stay consistent on restore
+(``torchft/manager.py:158-160``, ``train_ddp.py:200-207``).  This helper
+bundles user state + ``manager.state_dict()`` into one atomic step directory
+using the framework's own streaming pytree serialization (works for any
+pytree of jax/numpy arrays; orbax remains a fine alternative for sharded
+multi-host arrays).
+
+Usage::
+
+    if manager.current_step() % 100 == 0 and manager.participating_rank() == 0:
+        save_checkpoint(ckpt_dir, manager.current_step(),
+                        {"model": holder, "torchft": manager.state_dict()})
+
+    # on job restart
+    step = latest_step(ckpt_dir)
+    if step is not None:
+        state = load_checkpoint(ckpt_dir, step)
+        holder.update(state["model"])
+        manager.load_state_dict(state["torchft"])
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import tempfile
+from typing import Any, Optional
+
+from torchft_tpu.checkpointing.serialization import load_pytree, save_pytree
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _step_dir(base: str, step: int) -> str:
+    return os.path.join(base, f"step_{step}")
+
+
+def save_checkpoint(base_dir: str, step: int, state: Any, keep: int = 3) -> str:
+    """Atomically persist ``state`` for ``step``; prunes to ``keep`` newest."""
+    os.makedirs(base_dir, exist_ok=True)
+    final = _step_dir(base_dir, step)
+    tmp = tempfile.mkdtemp(prefix=f".step_{step}_", dir=base_dir)
+    try:
+        with open(os.path.join(tmp, "state.tftc"), "wb") as f:
+            save_pytree(state, f)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)  # atomic on the same filesystem
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+    if keep > 0:
+        steps = sorted(_all_steps(base_dir))
+        for old in steps[:-keep]:
+            shutil.rmtree(_step_dir(base_dir, old), ignore_errors=True)
+    return final
+
+
+def _all_steps(base_dir: str) -> list:
+    out = []
+    try:
+        entries = os.listdir(base_dir)
+    except FileNotFoundError:
+        return out
+    for entry in entries:
+        match = _STEP_RE.match(entry)
+        if match and os.path.exists(
+            os.path.join(base_dir, entry, "state.tftc")
+        ):
+            out.append(int(match.group(1)))
+    return out
+
+
+def latest_step(base_dir: str) -> Optional[int]:
+    steps = _all_steps(base_dir)
+    return max(steps) if steps else None
+
+
+def load_checkpoint(base_dir: str, step: int) -> Any:
+    with open(os.path.join(_step_dir(base_dir, step), "state.tftc"), "rb") as f:
+        return load_pytree(f)
