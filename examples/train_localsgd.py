@@ -83,7 +83,6 @@ def main() -> None:
 
     local_sgd = LocalSGD(manager, holder, sync_every=args.sync_every)
     loss_and_grad = jax.jit(jax.value_and_grad(model.loss))
-    inner_state = holder["opt_state"]
 
     syncs = 0
     with local_sgd:
@@ -91,13 +90,19 @@ def main() -> None:
             idx = rng.integers(0, len(x), size=args.batch_size)
             batch = (jnp.asarray(x[idx]), jnp.asarray(y[idx]))
             loss, grads = loss_and_grad(holder["params"], batch)
-            updates, inner_state = tx.update(grads, inner_state, holder["params"])
+            # optimizer state lives IN the holder so heals and durable
+            # checkpoints always carry the trained moments
+            updates, holder["opt_state"] = tx.update(
+                grads, holder["opt_state"], holder["params"]
+            )
             holder["params"] = optax.apply_updates(holder["params"], updates)
             result = local_sgd.step()
             if result is not None:
                 syncs += 1
                 logger.info("sync %d committed=%s loss %.4f", syncs, result, float(loss))
-                if args.ckpt_dir and result:
+                # one writer per checkpoint dir: the participating rank-0
+                # replica (see utils/checkpoint.py docstring)
+                if args.ckpt_dir and result and manager.participating_rank() == 0:
                     save_checkpoint(
                         args.ckpt_dir,
                         manager.current_step(),

@@ -63,11 +63,12 @@ futures.schedule_timeout(0.05, lambda: None)
 time.sleep(10)
 print("SHOULD NOT PRINT")
 """
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
         [sys.executable, "-c", script],
         capture_output=True,
         timeout=30,
-        cwd="/root/repo",
+        cwd=repo_root,
     )
     assert proc.returncode == 1
     assert b"SHOULD NOT PRINT" not in proc.stdout

@@ -88,7 +88,9 @@ class Llama:
     # params
     # ------------------------------------------------------------------
 
-    def init(self, key: jax.Array) -> Dict[str, Any]:
+    def init(self, key: jax.Array, include_ffn: bool = True) -> Dict[str, Any]:
+        """``include_ffn=False`` skips the dense FFN stacks (subclasses with
+        their own FFN, e.g. MoE, must never materialize them)."""
         cfg = self.config
         k_embed, k_layers, k_out = jax.random.split(key, 3)
 
@@ -105,12 +107,19 @@ class Llama:
             "wk": _norm(keys[1], (L, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
             "wv": _norm(keys[2], (L, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
             "wo": _norm(keys[3], (L, cfg.n_heads * hd, cfg.dim), cfg.n_heads * hd),
-            "w_gate": _norm(keys[4], (L, cfg.dim, cfg.ffn_hidden), cfg.dim),
-            "w_up": _norm(keys[5], (L, cfg.dim, cfg.ffn_hidden), cfg.dim),
-            "w_down": _norm(keys[6], (L, cfg.ffn_hidden, cfg.dim), cfg.ffn_hidden),
             "attn_norm": jnp.ones((L, cfg.dim), dtype=jnp.float32),
             "mlp_norm": jnp.ones((L, cfg.dim), dtype=jnp.float32),
         }
+        if include_ffn:
+            layers.update(
+                {
+                    "w_gate": _norm(keys[4], (L, cfg.dim, cfg.ffn_hidden), cfg.dim),
+                    "w_up": _norm(keys[5], (L, cfg.dim, cfg.ffn_hidden), cfg.dim),
+                    "w_down": _norm(
+                        keys[6], (L, cfg.ffn_hidden, cfg.dim), cfg.ffn_hidden
+                    ),
+                }
+            )
         return {
             "embed": _norm(k_embed, (cfg.vocab_size, cfg.dim), cfg.dim),
             "layers": layers,
@@ -206,14 +215,15 @@ class Llama:
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
-    def _layer(
+    def _attn_block(
         self, x: jax.Array, layer_params: Dict[str, jax.Array], rope, positions
     ) -> jax.Array:
+        """Pre-norm RoPE/GQA attention + residual — shared by dense and MoE
+        variants (the FFN half is the pluggable part)."""
         cfg = self.config
         cos, sin = rope
         B, S, _ = x.shape
         hd = cfg.head_dim
-
         h = self._rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
         q = (h @ layer_params["wq"]).reshape(B, S, cfg.n_heads, hd)
         k = (h @ layer_params["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
@@ -221,8 +231,13 @@ class Llama:
         q = self._apply_rope(q, cos, sin)
         k = self._apply_rope(k, cos, sin)
         attn = self._attention(q, k, v, positions)
-        x = x + attn.reshape(B, S, cfg.n_heads * hd) @ layer_params["wo"]
+        return x + attn.reshape(B, S, cfg.n_heads * hd) @ layer_params["wo"]
 
+    def _layer(
+        self, x: jax.Array, layer_params: Dict[str, jax.Array], rope, positions
+    ) -> jax.Array:
+        cfg = self.config
+        x = self._attn_block(x, layer_params, rope, positions)
         h = self._rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h @ layer_params["w_gate"])
         up = h @ layer_params["w_up"]
@@ -257,18 +272,21 @@ class Llama:
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return jnp.mean(nll)
 
-    def num_params(self) -> int:
+    def _attn_params_per_layer(self) -> int:
         cfg = self.config
         hd = cfg.head_dim
-        per_layer = (
+        return (
             cfg.dim * cfg.n_heads * hd  # wq
             + 2 * cfg.dim * cfg.n_kv_heads * hd  # wk, wv
             + cfg.n_heads * hd * cfg.dim  # wo
-            + 3 * cfg.dim * cfg.ffn_hidden  # gate, up, down
             + 2 * cfg.dim  # norms
         )
-        return (
-            cfg.vocab_size * cfg.dim * 2  # embed + lm_head
-            + cfg.n_layers * per_layer
-            + cfg.dim
-        )
+
+    def _embed_params(self) -> int:
+        cfg = self.config
+        return cfg.vocab_size * cfg.dim * 2 + cfg.dim  # embed + lm_head + final norm
+
+    def num_params(self) -> int:
+        cfg = self.config
+        per_layer = self._attn_params_per_layer() + 3 * cfg.dim * cfg.ffn_hidden
+        return self._embed_params() + cfg.n_layers * per_layer

@@ -60,6 +60,7 @@ class LlamaMoE(Llama):
                 ffn_hidden=config.ffn_hidden,
                 num_experts=config.num_experts,
                 capacity_factor=config.capacity_factor,
+                dtype=config.dtype,
             ),
             mesh=mesh,
             ep_axis=config.ep_axis,
@@ -69,11 +70,9 @@ class LlamaMoE(Llama):
 
     def init(self, key: jax.Array) -> Dict[str, Any]:
         cfg: LlamaMoEConfig = self.config  # type: ignore[assignment]
-        base = super().init(key)
-        layers = base["layers"]
-        # dense FFN weights are replaced by per-layer MoE params
-        for name in ("w_gate", "w_up", "w_down"):
-            del layers[name]
+        # include_ffn=False: the dense FFN stacks (the model's largest
+        # allocations) are never materialized
+        base = super().init(key, include_ffn=False)
         moe_keys = jax.random.split(jax.random.fold_in(key, 17), cfg.n_layers)
         base["moe_layers"] = [self.moe.init(k) for k in moe_keys]
         return base
@@ -95,22 +94,11 @@ class LlamaMoE(Llama):
         x = params["embed"][tokens].astype(cfg.dtype)
         positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
         rope = self._rope(positions)
-        hd = cfg.head_dim
 
         for layer in range(cfg.n_layers):
-            lp = {
-                k: v[layer]
-                for k, v in params["layers"].items()
-            }
-            h = self._rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-            q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
-            k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-            v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
-            q = self._apply_rope(q, rope[0], rope[1])
-            k = self._apply_rope(k, rope[0], rope[1])
-            attn = self._attention(q, k, v, positions)
-            x = x + attn.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
-
+            lp = {k: v[layer] for k, v in params["layers"].items()}
+            # shared attention half (Llama._attn_block); only the FFN differs
+            x = self._attn_block(x, lp, rope, positions)
             h = self._rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
             x = x + self.moe.apply(params["moe_layers"][layer], h).astype(cfg.dtype)
 
@@ -119,19 +107,10 @@ class LlamaMoE(Llama):
 
     def num_params(self) -> int:
         cfg: LlamaMoEConfig = self.config  # type: ignore[assignment]
-        hd = cfg.head_dim
-        attn = (
-            cfg.dim * cfg.n_heads * hd
-            + 2 * cfg.dim * cfg.n_kv_heads * hd
-            + cfg.n_heads * hd * cfg.dim
-            + 2 * cfg.dim
-        )
         moe = (
             cfg.dim * cfg.num_experts  # router
             + cfg.num_experts * cfg.dim * cfg.ffn_hidden * 2  # up + down
         )
-        return (
-            cfg.vocab_size * cfg.dim * 2
-            + cfg.n_layers * (attn + moe)
-            + cfg.dim
+        return self._embed_params() + cfg.n_layers * (
+            self._attn_params_per_layer() + moe
         )

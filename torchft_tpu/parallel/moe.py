@@ -41,6 +41,7 @@ class MoEConfig:
     ffn_hidden: int
     num_experts: int
     capacity_factor: float = 1.25
+    dtype: Any = jnp.float32  # expert weights/compute (bf16 for MXU models)
 
 
 class MoE:
@@ -57,15 +58,17 @@ class MoE:
         scale_in = 1.0 / np.sqrt(cfg.dim)
         scale_hidden = 1.0 / np.sqrt(cfg.ffn_hidden)
         return {
-            "router": jax.random.normal(k_router, (cfg.dim, cfg.num_experts)) * scale_in,
-            "w_up": jax.random.normal(
-                k_up, (cfg.num_experts, cfg.dim, cfg.ffn_hidden)
-            )
+            # router stays fp32: routing logits are precision-sensitive
+            "router": jax.random.normal(k_router, (cfg.dim, cfg.num_experts))
             * scale_in,
-            "w_down": jax.random.normal(
-                k_down, (cfg.num_experts, cfg.ffn_hidden, cfg.dim)
-            )
-            * scale_hidden,
+            "w_up": (
+                jax.random.normal(k_up, (cfg.num_experts, cfg.dim, cfg.ffn_hidden))
+                * scale_in
+            ).astype(cfg.dtype),
+            "w_down": (
+                jax.random.normal(k_down, (cfg.num_experts, cfg.ffn_hidden, cfg.dim))
+                * scale_hidden
+            ).astype(cfg.dtype),
         }
 
     def param_specs(self) -> Dict[str, Any]:
@@ -83,7 +86,7 @@ class MoE:
     ) -> Tuple[jax.Array, jax.Array]:
         """x [T, D] → (dispatch [E, C, T] one-hot-ish, combine [E, C, T])."""
         cfg = self.config
-        logits = x @ params["router"]  # [T, E]
+        logits = x.astype(jnp.float32) @ params["router"]  # [T, E] fp32
         probs = jax.nn.softmax(logits, axis=-1)
         expert = jnp.argmax(probs, axis=-1)  # [T]
         gate = jnp.max(probs, axis=-1)  # [T]

@@ -48,9 +48,18 @@ def save_checkpoint(base_dir: str, step: int, state: Any, keep: int = 3) -> str:
     try:
         with open(os.path.join(tmp, "state.tftc"), "wb") as f:
             save_pytree(state, f)
+            # durable means surviving power loss: flush the file and the
+            # directory entries before the rename is considered committed
+            f.flush()
+            os.fsync(f.fileno())
         if os.path.exists(final):
             shutil.rmtree(final)
         os.replace(tmp, final)  # atomic on the same filesystem
+        dir_fd = os.open(base_dir, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
@@ -85,3 +94,14 @@ def latest_step(base_dir: str) -> Optional[int]:
 def load_checkpoint(base_dir: str, step: int) -> Any:
     with open(os.path.join(_step_dir(base_dir, step), "state.tftc"), "rb") as f:
         return load_pytree(f)
+
+
+def load_latest(base_dir: str) -> Optional[tuple]:
+    """(step, state) of the newest *readable* checkpoint, falling back past
+    torn/corrupt step dirs; None when nothing restorable exists."""
+    for step in sorted(_all_steps(base_dir), reverse=True):
+        try:
+            return step, load_checkpoint(base_dir, step)
+        except Exception:  # noqa: BLE001 — torn write; try the next older
+            continue
+    return None
