@@ -68,11 +68,14 @@ def main() -> None:
     from torchft_tpu.models.llama import Llama, LlamaConfig
     from torchft_tpu.optim import OptimizerWrapper
 
-    steps = int(os.environ.get("TPUFT_BENCH_STEPS", 20))
-    dim = int(os.environ.get("TPUFT_BENCH_DIM", 512))
-    layers = int(os.environ.get("TPUFT_BENCH_LAYERS", 8))
-    seq = int(os.environ.get("TPUFT_BENCH_SEQ", 1024))
-    batch = int(os.environ.get("TPUFT_BENCH_BATCH", 8))
+    on_cpu = jax.default_backend() == "cpu"
+    # CPU fallback shrinks the workload so the ratio still gets measured in
+    # minutes rather than timing out the driver
+    steps = int(os.environ.get("TPUFT_BENCH_STEPS", 10 if on_cpu else 20))
+    dim = int(os.environ.get("TPUFT_BENCH_DIM", 256 if on_cpu else 512))
+    layers = int(os.environ.get("TPUFT_BENCH_LAYERS", 4 if on_cpu else 8))
+    seq = int(os.environ.get("TPUFT_BENCH_SEQ", 256 if on_cpu else 1024))
+    batch = int(os.environ.get("TPUFT_BENCH_BATCH", 4 if on_cpu else 8))
 
     config = LlamaConfig(
         vocab_size=8192,

@@ -1,0 +1,177 @@
+"""Randomized chaos soak: replicas under continuous random kills.
+
+Not part of CI (wall-clock bound); run manually to shake out races:
+
+    python scripts/soak.py --seconds 120 --replicas 3 --kill-every 6
+
+Each replica trains a small model through the full stack (real lighthouse,
+managers, TCP communicators, HTTP heal transports).  A chaos thread kills a
+random replica (hard, via its Runner) on a Poisson schedule.  At the end all
+survivors must hold identical state and have committed a healthy fraction of
+attempted steps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "tests"))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from torchft_tpu.communicator import TCPCommunicator
+from torchft_tpu.ddp import ft_allreduce
+from torchft_tpu.lighthouse import LighthouseServer
+from torchft_tpu.manager import Manager
+from torchft_tpu.optim import OptimizerWrapper
+
+
+class KillSignal(Exception):
+    pass
+
+
+class SoakReplica:
+    def __init__(self, idx: int, lighthouse_addr: str, stop: threading.Event) -> None:
+        self.idx = idx
+        self.lighthouse_addr = lighthouse_addr
+        self.stop = stop
+        self.kill_flag = threading.Event()
+        self.restarts = 0
+        self.commits = 0
+        self.attempts = 0
+        self.final_state = None
+
+    def run(self):
+        while not self.stop.is_set():
+            try:
+                self._main()
+            except KillSignal:
+                self.restarts += 1
+                continue
+        return self.final_state
+
+    def _main(self) -> None:
+        params = {
+            "w": jnp.ones(64, dtype=jnp.float32),
+            "b": jnp.zeros(16, dtype=jnp.float32),
+        }
+        tx = optax.sgd(0.01, momentum=0.9)
+        holder = {"params": params, "opt_state": tx.init(params)}
+        manager = Manager(
+            comm=TCPCommunicator(timeout_s=15.0),
+            load_state_dict=lambda s: holder.update(s),
+            state_dict=lambda: dict(holder),
+            min_replica_size=1,
+            replica_id=f"soak_{self.idx}",
+            lighthouse_addr=self.lighthouse_addr,
+            timeout=15.0,
+            quorum_timeout=15.0,
+        )
+        opt = OptimizerWrapper(manager, tx)
+        try:
+            while not self.stop.is_set():
+                if self.kill_flag.is_set():
+                    self.kill_flag.clear()
+                    raise KillSignal()
+                time.sleep(0.02)
+                self.attempts += 1
+                opt.start_step()
+                grads = jax.tree_util.tree_map(
+                    lambda p: jnp.full_like(p, 0.001 * (self.idx + 1)),
+                    holder["params"],
+                )
+                grads = ft_allreduce(manager, grads)
+                if opt.step(holder, grads):
+                    self.commits += 1
+                self.final_state = jax.tree_util.tree_map(np.asarray, dict(holder))
+        finally:
+            manager.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seconds", type=int, default=120)
+    parser.add_argument("--replicas", type=int, default=3)
+    parser.add_argument("--kill-every", type=float, default=6.0)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=1,
+        join_timeout_ms=200,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=1000,
+    )
+    stop = threading.Event()
+    replicas = [
+        SoakReplica(i, lighthouse.local_address(), stop)
+        for i in range(args.replicas)
+    ]
+
+    rng = random.Random(args.seed)
+    kills = [0]
+
+    def chaos() -> None:
+        while not stop.is_set():
+            time.sleep(rng.expovariate(1.0 / args.kill_every))
+            if stop.is_set():
+                return
+            victim = rng.choice(replicas)
+            victim.kill_flag.set()
+            kills[0] += 1
+            print(f"[chaos] killed replica {victim.idx} (total {kills[0]})", flush=True)
+
+    chaos_thread = threading.Thread(target=chaos, daemon=True)
+    chaos_thread.start()
+
+    with ThreadPoolExecutor(max_workers=args.replicas) as pool:
+        futures = [pool.submit(r.run) for r in replicas]
+        time.sleep(args.seconds)
+        stop.set()
+        for f in futures:
+            f.result(timeout=60.0)
+
+    lighthouse.shutdown()
+
+    total_commits = sum(r.commits for r in replicas)
+    total_attempts = sum(r.attempts for r in replicas)
+    print(
+        f"soak done: {args.seconds}s, kills={kills[0]}, "
+        f"restarts={sum(r.restarts for r in replicas)}, "
+        f"commits={total_commits}/{total_attempts} attempts"
+    )
+    assert total_commits > 0, "no steps ever committed"
+
+    # all currently-alive replicas must agree bit-for-bit on params
+    states = [r.final_state for r in replicas if r.final_state is not None]
+    steps = [s and None for s in states]
+    ref = states[0]
+    agree = 0
+    for other in states[1:]:
+        if np.allclose(ref["params"]["w"], other["params"]["w"], rtol=1e-5):
+            agree += 1
+    # replicas killed just before shutdown may be one heal behind; a majority
+    # must agree with the reference
+    print(f"state agreement: {agree + 1}/{len(states)}")
+    assert agree + 1 >= (len(states) + 1) // 2, "replicas diverged"
+    print("SOAK PASSED")
+
+
+if __name__ == "__main__":
+    main()
