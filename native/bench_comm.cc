@@ -1,0 +1,62 @@
+// Standalone throughput benchmark for the native communicator (no Python):
+//   ./bench_comm            — forks store + 2 ranks, 256MB p2p + ring
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include "comm.h"
+#include "store.h"
+
+using namespace tpuft;
+
+static void run_rank(const std::string& store_addr, int rank) {
+  Communicator comm(60.0);
+  comm.configure(store_addr + "/bench", rank, 2);
+  const size_t N = 256ull << 20;
+  std::vector<uint8_t> payload(N, 7);
+
+  // p2p warm + timed
+  if (rank == 0) {
+    comm.send(payload.data(), N, 1, 1);
+    auto t0 = std::chrono::steady_clock::now();
+    comm.send(payload.data(), N, 1, 2);
+    double dt = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0).count();
+    std::printf("send 256MB: %.3fs (%.2f GB/s)\n", dt, N / dt / 1e9);
+  } else {
+    comm.recv_dynamic(0, 1);
+    auto t0 = std::chrono::steady_clock::now();
+    auto data = comm.recv_dynamic(0, 2);
+    double dt = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0).count();
+    std::printf("recv 256MB: %.3fs (%.2f GB/s)\n", dt, data.size() / dt / 1e9);
+  }
+
+  // ring allreduce 128MB f32
+  std::vector<float> buf(32 << 20, 1.0f);
+  comm.allreduce(buf.data(), buf.size() * 4, DT_F32, OP_SUM);  // warm
+  auto t0 = std::chrono::steady_clock::now();
+  comm.allreduce(buf.data(), buf.size() * 4, DT_F32, OP_SUM);
+  double dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0).count();
+  std::printf("rank %d ring 128MB: %.3fs (%.2f GB/s effective)\n", rank, dt,
+              buf.size() * 4.0 / dt / 1e9);
+}
+
+int main() {
+  StoreServer store("127.0.0.1:0");
+  std::string addr = "127.0.0.1:" + std::to_string(store.port());
+  pid_t pid = fork();
+  if (pid == 0) {
+    run_rank(addr, 1);
+    _exit(0);
+  }
+  run_rank(addr, 0);
+  int status = 0;
+  waitpid(pid, &status, 0);
+  return 0;
+}
