@@ -157,6 +157,12 @@ int tpuft_comm_recv_alloc(void* h, int64_t src, uint64_t tag, uint8_t** out,
 
 void tpuft_buffer_free(void* p) { std::free(p); }
 
+int tpuft_comm_recv_into(void* h, int64_t src, uint64_t tag, void* buf,
+                         uint64_t cap, uint64_t* out_n) {
+  auto* comm = static_cast<tpuft::Communicator*>(h);
+  return guarded([&] { *out_n = comm->recv_into(src, tag, buf, cap); });
+}
+
 int tpuft_comm_alltoall(void* h, const void* in, void* out,
                         uint64_t chunk_bytes, uint64_t tag) {
   auto* comm = static_cast<tpuft::Communicator*>(h);

@@ -345,6 +345,22 @@ class Communicator {
              deadline);
   }
 
+  // zero-copy: receive one frame directly into a caller buffer; returns
+  // the payload size (must be <= cap)
+  size_t recv_into(int64_t src, uint64_t tag, void* buf, size_t cap) {
+    auto deadline = deadline_in(timeout_s_);
+    int fd = peer_fd(src);
+    uint64_t hdr[2];
+    recv_loop(fd, src, hdr, 16, deadline);
+    if (hdr[1] != tag)
+      throw CommError("tag mismatch from rank " + std::to_string(src));
+    if (hdr[0] > cap)
+      throw CommError("recv_into buffer too small: payload " +
+                      std::to_string(hdr[0]) + " > cap " + std::to_string(cap));
+    recv_loop(fd, src, buf, hdr[0], deadline);
+    return hdr[0];
+  }
+
   // receiver learns the size from the frame header
   std::vector<uint8_t> recv_dynamic(int64_t src, uint64_t tag) {
     auto deadline = deadline_in(timeout_s_);

@@ -156,3 +156,20 @@ def test_reduce_scatter_quantized(store) -> None:
     # a step is rowmax/127 (~96 for the largest row here)
     atol = 1.5 * np.abs(expected).max() / 127.0
     np.testing.assert_allclose(got, expected, rtol=0.02, atol=atol)
+
+
+def test_recv_bytes_into_zero_copy(store) -> None:
+    world_size = 2
+    payload = np.arange(1000, dtype=np.float32)
+
+    def _fn(comm, rank):
+        if rank == 0:
+            comm.send_bytes(bytes(payload.tobytes()), dst=1, tag=77).wait(timeout=30.0)
+            return None
+        out = np.zeros(1000, dtype=np.float32)
+        n = comm.recv_bytes_into(1 - rank, out.view(np.uint8), tag=77).wait(timeout=30.0)
+        assert n == payload.nbytes
+        return out
+
+    results = _run_ranks(store, world_size, _fn)
+    np.testing.assert_array_equal(results[1], payload)

@@ -398,6 +398,85 @@ class TestCppCommunicator:
         np.testing.assert_allclose(recovered[0], np.full(4, 2.0))
 
 
+def test_full_native_stack_kill_and_heal() -> None:
+    """The whole FT protocol on the native runtime: C++ lighthouse, C++
+    manager sidecars, C++ communicators — threads-as-replicas with a kill,
+    restart, live heal, and final state equality."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchft_tpu.ddp import ft_allreduce
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.optim import OptimizerWrapper
+
+    lighthouse = native.CppLighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100, quorum_tick_ms=20,
+        heartbeat_timeout_ms=1000,
+    )
+
+    class Killed(Exception):
+        pass
+
+    kill_once = {"armed": True}
+    states = {}
+
+    def replica(idx: int) -> None:
+        while True:
+            comm = native.CppCommunicator(timeout_s=10.0)
+            params = {"w": jnp.ones(32, dtype=jnp.float32)}
+            tx = optax.sgd(0.05)
+            holder = {"params": params, "opt_state": tx.init(params)}
+            manager = Manager(
+                comm=comm,
+                load_state_dict=lambda s: holder.update(s),
+                state_dict=lambda: dict(holder),
+                min_replica_size=1,
+                replica_id=f"native_{idx}",
+                lighthouse_addr=lighthouse.local_address(),
+                timeout=10.0,
+                quorum_timeout=10.0,
+                server_cls=native.CppManagerServer,
+            )
+            opt = OptimizerWrapper(manager, tx)
+            try:
+                while manager.current_step() < 10:
+                    time.sleep(0.03)
+                    if idx == 1 and manager.current_step() == 3 and kill_once["armed"]:
+                        kill_once["armed"] = False
+                        raise Killed()
+                    opt.start_step()
+                    grads = jax.tree_util.tree_map(
+                        lambda p: jnp.full_like(p, 0.01 * (idx + 1)),
+                        holder["params"],
+                    )
+                    grads = ft_allreduce(manager, grads)
+                    opt.step(holder, grads)
+                states[idx] = np.asarray(holder["params"]["w"])
+                return
+            except Killed:
+                manager.shutdown()
+                continue
+            finally:
+                if manager.current_step() >= 10:
+                    manager.shutdown()
+
+    try:
+        threads = [
+            threading.Thread(target=replica, args=(i,), daemon=True)
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert set(states) == {0, 1}
+        np.testing.assert_allclose(states[0], states[1], rtol=1e-6)
+        assert not kill_once["armed"], "the kill never fired"
+    finally:
+        lighthouse.shutdown()
+
+
 def test_cpp_faster_than_python_tier(cpp_store) -> None:
     """The native tier must beat the Python TCP tier on a 16MB allreduce."""
     from torchft_tpu.communicator import TCPCommunicator

@@ -126,14 +126,19 @@ class CommTransport(CheckpointTransport[T]):
 
         arrays: List[np.ndarray] = []
         for i, (dtype_name, shape) in enumerate(array_meta):
-            blob = self._comm.recv_bytes(src_rank, tag=base + 1 + i).wait(
-                timeout=timeout
-            )
             target = inplace[i]
             if target is None:
                 target = np.empty(tuple(shape), dtype=_resolve_dtype(dtype_name))
-            view = as_byte_view(target)
-            view[:] = blob
+            try:
+                # zero-copy: land the payload straight in the target buffer
+                self._comm.recv_bytes_into(
+                    src_rank, target.reshape(-1).view(np.uint8), tag=base + 1 + i
+                ).wait(timeout=timeout)
+            except NotImplementedError:
+                blob = self._comm.recv_bytes(src_rank, tag=base + 1 + i).wait(
+                    timeout=timeout
+                )
+                as_byte_view(target)[:] = blob
             arrays.append(target)
         logger.info(
             "received checkpoint step=%d (%d arrays) from rank %d",

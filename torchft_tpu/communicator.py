@@ -132,6 +132,11 @@ class Communicator(ABC):
     def allgather(self, data: np.ndarray, tag: int = 0) -> Work:
         raise NotImplementedError
 
+    def recv_bytes_into(self, src: int, out: np.ndarray, tag: int = 0) -> Work:
+        """Zero-copy variant: receive one frame directly into ``out`` (a
+        contiguous writable array); the Work's value is the payload size."""
+        raise NotImplementedError
+
     @abstractmethod
     def abort(self, reason: str = "aborted") -> None:
         ...
@@ -665,6 +670,19 @@ class TCPCommunicator(Communicator):
 
         return self._submit(_make)
 
+    def recv_bytes_into(self, src: int, out: np.ndarray, tag: int = 0) -> Work:
+        view = _bytes_view(out)
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                mesh = ctx.require_peer(src)
+                mesh.exchange([], [(src, tag, view)], ctx.deadline())
+                return len(view)
+
+            return _run
+
+        return self._submit(_make)
+
     def _all_exchange(
         self,
         send_for_peer: Callable[[int], np.ndarray],
@@ -904,6 +922,9 @@ class DummyCommunicator(Communicator):
 
     def recv_bytes(self, src: int, tag: int = 0) -> Work:
         return DummyWork(b"")
+
+    def recv_bytes_into(self, src, out, tag: int = 0) -> Work:
+        return DummyWork(0)
 
     def alltoall(self, chunks, tag: int = 0) -> Work:
         # mirror-world fiction: every peer sends us what we'd send ourselves

@@ -160,6 +160,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.tpuft_buffer_free.argtypes = [ctypes.c_void_p]
+        lib.tpuft_comm_recv_into.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         lib.tpuft_comm_alltoall.argtypes = [
             ctypes.c_void_p,
             ctypes.c_void_p,
@@ -606,6 +614,26 @@ class CppCommunicator(Communicator):
                 return ctypes.string_at(out, n.value)
             finally:
                 self._lib.tpuft_buffer_free(out)
+
+        return self._submit(_run)
+
+    def recv_bytes_into(self, src: int, out: np.ndarray, tag: int = 0) -> Work:
+        assert out.flags.c_contiguous and out.flags.writeable
+
+        def _run() -> object:
+            n = ctypes.c_uint64()
+            self._check(
+                self._lib.tpuft_comm_recv_into(
+                    self._h,
+                    src,
+                    tag,
+                    out.ctypes.data_as(ctypes.c_void_p),
+                    out.nbytes,
+                    ctypes.byref(n),
+                ),
+                "recv_into",
+            )
+            return int(n.value)
 
         return self._submit(_run)
 
