@@ -354,9 +354,18 @@ class Communicator {
     recv_loop(fd, src, hdr, 16, deadline);
     if (hdr[1] != tag)
       throw CommError("tag mismatch from rank " + std::to_string(src));
-    if (hdr[0] > cap)
+    if (hdr[0] > cap) {
+      // drain the payload so the stream stays frame-aligned, THEN fail
+      std::vector<uint8_t> scratch(1 << 20);
+      uint64_t remaining = hdr[0];
+      while (remaining > 0) {
+        size_t take = std::min<uint64_t>(remaining, scratch.size());
+        recv_loop(fd, src, scratch.data(), take, deadline);
+        remaining -= take;
+      }
       throw CommError("recv_into buffer too small: payload " +
                       std::to_string(hdr[0]) + " > cap " + std::to_string(cap));
+    }
     recv_loop(fd, src, buf, hdr[0], deadline);
     return hdr[0];
   }

@@ -240,6 +240,26 @@ class BabyCommunicator(Communicator):
     def recv_bytes(self, src: int, tag: int = 0) -> Work:
         return self._submit("recv_bytes", dict(src=src, tag=tag))
 
+    def recv_bytes_into(self, src: int, out, tag: int = 0) -> Work:
+        # API uniformity: the pipe hop precludes true zero-copy; copy into
+        # the caller's buffer on completion
+        work = self._submit("recv_bytes", dict(src=src, tag=tag))
+
+        def _land(blob: object) -> int:
+            data = memoryview(blob)  # type: ignore[arg-type]
+            if len(data) > out.nbytes:
+                raise CommunicatorError(
+                    f"recv buffer too small: payload {len(data)} > cap {out.nbytes}"
+                )
+            import numpy as _np
+
+            out.reshape(-1).view(_np.uint8)[: len(data)] = _np.frombuffer(
+                data, dtype=_np.uint8
+            )
+            return len(data)
+
+        return work.then(_land)
+
     def barrier(self) -> Work:
         return self._submit("barrier", dict())
 

@@ -131,9 +131,14 @@ class CommTransport(CheckpointTransport[T]):
                 target = np.empty(tuple(shape), dtype=_resolve_dtype(dtype_name))
             try:
                 # zero-copy: land the payload straight in the target buffer
-                self._comm.recv_bytes_into(
+                got = self._comm.recv_bytes_into(
                     src_rank, target.reshape(-1).view(np.uint8), tag=base + 1 + i
                 ).wait(timeout=timeout)
+                if got != target.nbytes:
+                    raise ValueError(
+                        f"checkpoint array {i}: payload {got} bytes != "
+                        f"expected {target.nbytes}"
+                    )
             except NotImplementedError:
                 blob = self._comm.recv_bytes(src_rank, tag=base + 1 + i).wait(
                     timeout=timeout

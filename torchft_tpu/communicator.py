@@ -262,6 +262,53 @@ class _TcpMesh:
         if self._aborted.is_set():
             raise CommunicatorAborted("communicator aborted")
 
+    def recv_dynamic_into(
+        self, src: int, tag: int, view: memoryview, deadline: float
+    ) -> int:
+        """Header-aware zero-copy receive: payload lands in ``view`` (cap
+        semantics — payload may be smaller); returns the payload size."""
+        sock = self.peers[src]
+
+        def _recv_some(into: memoryview) -> int:
+            while True:
+                self._check_abort()
+                if time.monotonic() > deadline:
+                    raise TimeoutError("recv_dynamic_into timed out")
+                readable, _, _ = select.select([sock], [], [], 0.1)
+                if not readable:
+                    continue
+                try:
+                    n = sock.recv_into(into)
+                except BlockingIOError:
+                    continue
+                if n == 0:
+                    raise CommunicatorError(f"connection to rank {src} closed")
+                return n
+
+        hdr = bytearray(_HDR.size)
+        off = 0
+        while off < len(hdr):
+            off += _recv_some(memoryview(hdr)[off:])
+        nbytes, rtag = _HDR.unpack(bytes(hdr))
+        if rtag != tag:
+            raise CommunicatorError(
+                f"tag mismatch from rank {src}: got {rtag}, want {tag}"
+            )
+        if nbytes > len(view):
+            # drain into scratch so the stream stays frame-aligned, THEN fail
+            scratch = bytearray(min(1 << 20, nbytes))
+            remaining = nbytes
+            while remaining > 0:
+                got = _recv_some(memoryview(scratch)[: min(len(scratch), remaining)])
+                remaining -= got
+            raise CommunicatorError(
+                f"recv buffer too small: payload {nbytes} > cap {len(view)}"
+            )
+        off = 0
+        while off < nbytes:
+            off += _recv_some(view[off:nbytes])
+        return nbytes
+
     def recv_dynamic(self, src: int, tag: int, deadline: float) -> bytes:
         """Receive one frame from ``src`` without knowing its size upfront —
         the frame header carries nbytes, so this pairs with any plain send."""
@@ -676,8 +723,9 @@ class TCPCommunicator(Communicator):
         def _make(ctx: "_CommCtx") -> Callable[[], object]:
             def _run() -> object:
                 mesh = ctx.require_peer(src)
-                mesh.exchange([], [(src, tag, view)], ctx.deadline())
-                return len(view)
+                # cap semantics (payload may be smaller than the buffer),
+                # matching the native tier's recv_into contract
+                return mesh.recv_dynamic_into(src, tag, view, ctx.deadline())
 
             return _run
 
@@ -990,6 +1038,9 @@ class FakeCommunicatorWrapper(Communicator):
     def recv_bytes(self, src: int, tag: int = 0) -> Work:
         return self._wrap(self._comm.recv_bytes(src, tag))
 
+    def recv_bytes_into(self, src: int, out, tag: int = 0) -> Work:
+        return self._wrap(self._comm.recv_bytes_into(src, out, tag))
+
     def alltoall(self, chunks, tag: int = 0) -> Work:
         return self._wrap(self._comm.alltoall(chunks, tag))
 
@@ -1042,6 +1093,9 @@ class ManagedCommunicator(Communicator):
 
     def recv_bytes(self, src: int, tag: int = 0) -> Work:
         return self._manager._comm.recv_bytes(src, tag)
+
+    def recv_bytes_into(self, src: int, out, tag: int = 0) -> Work:
+        return self._manager._comm.recv_bytes_into(src, out, tag)
 
     def barrier(self) -> Work:
         return self._manager._comm.barrier()
