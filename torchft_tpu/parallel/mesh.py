@@ -33,13 +33,14 @@ class MeshAxes:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1
 
     @property
     def total(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep
 
 
-AXIS_NAMES: Tuple[str, ...] = ("dp", "fsdp", "tp", "sp")
+AXIS_NAMES: Tuple[str, ...] = ("dp", "fsdp", "ep", "sp", "tp")
 
 
 def make_mesh(
@@ -47,24 +48,25 @@ def make_mesh(
     fsdp: int = 1,
     tp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a mesh with axes (dp, fsdp, tp, sp).
+    """Build a mesh with axes (dp, fsdp, ep, sp, tp).
 
     Axis order puts ``tp`` innermost so tensor-parallel collectives ride the
-    fastest ICI links, then ``sp`` (ring attention neighbor exchanges), with
-    ``dp``/``fsdp`` outermost — the standard layout recipe for TPU pods.
+    fastest ICI links, then ``sp`` (ring attention neighbor exchanges) and
+    ``ep`` (MoE all_to_all), with ``dp``/``fsdp`` outermost — the standard
+    layout recipe for TPU pods.
     """
-    axes = MeshAxes(dp=dp, fsdp=fsdp, tp=tp, sp=sp)
+    axes = MeshAxes(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
     if devices is None:
         devices = jax.devices()
     if axes.total > len(devices):
         raise ValueError(
             f"mesh needs {axes.total} devices, only {len(devices)} available"
         )
-    devices = np.asarray(devices[: axes.total]).reshape(dp, fsdp, sp, tp)
-    # Mesh axis order: (dp, fsdp, sp, tp); names must match positions
-    return Mesh(devices, ("dp", "fsdp", "sp", "tp"))
+    devices = np.asarray(devices[: axes.total]).reshape(dp, fsdp, ep, sp, tp)
+    return Mesh(devices, AXIS_NAMES)
 
 
 def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
