@@ -9,7 +9,7 @@ ranks, and fetches a peer's state dict when this replica heals.
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from typing import Dict, Generic, List, TypeVar
+from typing import Generic, List, TypeVar
 
 T = TypeVar("T")
 

@@ -14,7 +14,7 @@ random-access dataset composes with it.
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional
+from typing import Iterator, List
 
 import numpy as np
 

@@ -36,7 +36,7 @@ from typing import Callable, Dict, List, Optional, TypeVar, Union, cast
 import numpy as np
 
 from torchft_tpu.checkpointing._rwlock import RWLock
-from torchft_tpu.observability import QuorumTracer, record_function, traced
+from torchft_tpu.observability import QuorumTracer, traced
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.communicator import Communicator, ReduceOp
 from torchft_tpu.manager_server import ManagerClient, ManagerServer

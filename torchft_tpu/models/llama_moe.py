@@ -15,7 +15,7 @@ MoE models are typically shallow-wide, so compile time stays acceptable).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -33,7 +33,7 @@ from torchft_tpu.communicator import (
     ReduceOp,
 )
 from torchft_tpu.futures import TimerHandle, schedule_timeout
-from torchft_tpu.work import DummyWork, Work
+from torchft_tpu.work import Work
 
 logger = logging.getLogger(__name__)
 

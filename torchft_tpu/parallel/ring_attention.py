@@ -17,8 +17,6 @@ diagonal block is masked triangularly, earlier blocks attend fully.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any
-
 import jax
 import jax.numpy as jnp
 import numpy as np
