@@ -515,3 +515,51 @@ def test_cpp_faster_than_python_tier(cpp_store) -> None:
     # same loopback bytes and this test shares the machine with the rest of
     # the suite); only an order-of-magnitude sanity bound is stable.
     assert cpp_t < 15.0
+
+
+def test_cross_implementation_rendezvous() -> None:
+    """Implementation matrix: a Python TCP communicator rendezvousing on a
+    C++ store, paired against a C++ communicator on the same store — the
+    wire protocol is one contract regardless of implementation language."""
+    from torchft_tpu.communicator import TCPCommunicator
+
+    store = native.CppStoreServer("127.0.0.1:0")
+    results = {}
+
+    def _py_rank() -> None:
+        comm = TCPCommunicator(timeout_s=30.0)
+        comm.configure(
+            f"127.0.0.1:{store.port}/xmatrix", replica_id="py", rank=0, world_size=2
+        )
+        try:
+            results[0] = comm.allreduce(
+                np.full(64, 1.0, dtype=np.float32), ReduceOp.SUM
+            ).wait(timeout=30.0)
+        finally:
+            comm.shutdown()
+
+    def _cpp_rank() -> None:
+        comm = native.CppCommunicator(timeout_s=30.0)
+        comm.configure(
+            f"127.0.0.1:{store.port}/xmatrix", replica_id="cpp", rank=1, world_size=2
+        )
+        try:
+            results[1] = comm.allreduce(
+                np.full(64, 2.0, dtype=np.float32), ReduceOp.SUM
+            ).wait(timeout=30.0)
+        finally:
+            comm.shutdown()
+
+    try:
+        threads = [
+            threading.Thread(target=_py_rank),
+            threading.Thread(target=_cpp_rank),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        np.testing.assert_allclose(results[0], np.full(64, 3.0))
+        np.testing.assert_allclose(results[1], np.full(64, 3.0))
+    finally:
+        store.shutdown()
