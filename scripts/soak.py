@@ -46,7 +46,10 @@ class KillSignal(Exception):
 
 
 class SoakReplica:
-    def __init__(self, idx: int, lighthouse_addr: str, stop: threading.Event) -> None:
+    def __init__(
+        self, idx: int, lighthouse_addr: str, stop: threading.Event, backend: str = "tcp"
+    ) -> None:
+        self.backend = backend
         self.idx = idx
         self.lighthouse_addr = lighthouse_addr
         self.stop = stop
@@ -72,8 +75,14 @@ class SoakReplica:
         }
         tx = optax.sgd(0.01, momentum=0.9)
         holder = {"params": params, "opt_state": tx.init(params)}
+        if self.backend == "cpp":
+            from torchft_tpu.native import CppCommunicator
+
+            comm = CppCommunicator(timeout_s=15.0)
+        else:
+            comm = TCPCommunicator(timeout_s=15.0)
         manager = Manager(
-            comm=TCPCommunicator(timeout_s=15.0),
+            comm=comm,
             load_state_dict=lambda s: holder.update(s),
             state_dict=lambda: dict(holder),
             min_replica_size=1,
@@ -109,6 +118,7 @@ def main() -> None:
     parser.add_argument("--replicas", type=int, default=3)
     parser.add_argument("--kill-every", type=float, default=6.0)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--backend", choices=["tcp", "cpp"], default="tcp")
     args = parser.parse_args()
 
     lighthouse = LighthouseServer(
@@ -120,7 +130,7 @@ def main() -> None:
     )
     stop = threading.Event()
     replicas = [
-        SoakReplica(i, lighthouse.local_address(), stop)
+        SoakReplica(i, lighthouse.local_address(), stop, backend=args.backend)
         for i in range(args.replicas)
     ]
 
