@@ -214,3 +214,65 @@ class TestHTTPTransport:
         finally:
             sender.shutdown()
             receiver.shutdown()
+
+
+def test_chunked_fetch_error_not_masked_as_timeout(monkeypatch) -> None:
+    """A real fetch failure (connection refused) in a chunk thread must
+    surface as that error, under one shared deadline (ADVICE r1)."""
+    import time as _time
+
+    import torchft_tpu.checkpointing.http_transport as ht
+
+    sender = HTTPTransport(timeout=10.0, num_chunks=3)
+    receiver = HTTPTransport(timeout=10.0, num_chunks=3)
+    try:
+        sender.send_checkpoint(
+            [1], step=1, state_dict={"a": np.arange(64)}, timeout=5.0
+        )
+        real_urlopen = ht.urlopen
+        calls = {"n": 0}
+
+        def flaky(url, timeout=None):
+            calls["n"] += 1
+            if calls["n"] > 1:  # first (synchronous) fetch succeeds
+                raise ConnectionRefusedError("injected chunk failure")
+            return real_urlopen(url, timeout=timeout)
+
+        monkeypatch.setattr(ht, "urlopen", flaky)
+        t0 = _time.monotonic()
+        with pytest.raises(ConnectionRefusedError):
+            receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=1, timeout=5.0
+            )
+        assert _time.monotonic() - t0 < 4.0  # one deadline, not N*timeout
+    finally:
+        sender.shutdown()
+        receiver.shutdown()
+
+
+def test_sharded_host_array_restore_like() -> None:
+    """restore_like rebuilds a sharded device array from a ShardedHostArray
+    (the multi-host heal payload) without materializing it unsharded."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchft_tpu.checkpointing.serialization import (
+        ShardedHostArray,
+        shard_key,
+    )
+    from torchft_tpu.ddp import restore_like
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("fsdp",))
+    sh = NamedSharding(mesh, P("fsdp"))
+    old = jax.device_put(np.zeros((8, 3), np.float32), sh)
+
+    want = np.arange(24, dtype=np.float32).reshape(8, 3)
+    shards = {}
+    for s in old.addressable_shards:
+        k = shard_key(s.index, old.shape)
+        shards[k] = want[s.index]
+    new = ShardedHostArray(shape=(8, 3), dtype="float32", shards=shards)
+
+    restored = restore_like(new, old)
+    assert restored.sharding == sh
+    np.testing.assert_array_equal(np.asarray(restored), want)

@@ -304,6 +304,67 @@ class TestAllreduce:
         assert client.commit_calls[0]["should_commit"] is False
 
 
+    def test_should_commit_fences_inflight_collectives(self) -> None:
+        """A collective failure landing after the vote must not let this
+        replica commit (ADVICE r1: analog of the reference's stream sync,
+        ``manager.py:888-893``)."""
+        import threading as _threading
+        import time as _time
+        from concurrent.futures import Future
+
+        from torchft_tpu.work import Work
+
+        class SlowFailingCommunicator(DummyCommunicator):
+            def allreduce(self, buffers, op=None):  # type: ignore[override]
+                fut: Future = Future()
+
+                def _later() -> None:
+                    _time.sleep(0.3)
+                    fut.set_exception(RuntimeError("late collective failure"))
+
+                _threading.Thread(target=_later, daemon=True).start()
+                return Work(fut)
+
+        client = StubClient()
+        client.quorum_results.append(_quorum_result())
+        client.commit_responses.append(False)
+        manager = _make_manager(client, comm=SlowFailingCommunicator())
+        manager.start_quorum()
+        manager.allreduce(np.ones(3))  # deliberately not waited
+        assert manager.errored() is None  # failure hasn't landed yet
+        assert not manager.should_commit()
+        assert manager.errored() is not None
+        assert client.commit_calls[0]["should_commit"] is False
+
+    def test_should_commit_waits_slow_successful_work(self) -> None:
+        import threading as _threading
+        import time as _time
+        from concurrent.futures import Future
+
+        from torchft_tpu.work import Work
+
+        class SlowCommunicator(DummyCommunicator):
+            def allreduce(self, buffers, op=None):  # type: ignore[override]
+                fut: Future = Future()
+
+                def _later() -> None:
+                    _time.sleep(0.3)
+                    fut.set_result(buffers)
+
+                _threading.Thread(target=_later, daemon=True).start()
+                return Work(fut)
+
+        client = StubClient()
+        client.quorum_results.append(_quorum_result())
+        manager = _make_manager(client, comm=SlowCommunicator())
+        manager.start_quorum()
+        work = manager.allreduce(np.full(3, 8.0))
+        assert manager.should_commit()
+        # fencing implies the work is complete by the time the vote returns
+        assert work.done()
+        np.testing.assert_array_equal(work.wait(timeout=0), np.full(3, 4.0))
+
+
 class TestShouldCommit:
     def test_not_enough_replicas_votes_false(self) -> None:
         client = StubClient()

@@ -471,3 +471,177 @@ def test_three_replicas_one_kill(lighthouse) -> None:
     ]
     states = _run(runners)
     _assert_all_equal(states)
+
+
+# ---------------------------------------------------------------------------
+# Multi-rank replica groups: N ranks share one ManagerServer + store
+# (``manager_integ_test.py:484-522``; barrier in ``src/manager.rs:332-402``)
+# ---------------------------------------------------------------------------
+
+
+class MultiRankRunner:
+    """One replica group of ``world_size`` rank-threads sharing a store.
+
+    Each rank owns a DISTINCT param slice (the stand-in for a sharded
+    model): rank r of every group starts identical, rings only with rank r
+    of the other groups, and heals rank-to-rank via per-rank checkpoint
+    metadata.  A whole-group kill (the multi-host reality: losing a host
+    kills the group) is injected by failing every rank at the same step.
+    """
+
+    def __init__(
+        self,
+        replica_idx: int,
+        lighthouse_addr: str,
+        injector: EventInjector,
+        num_steps: int,
+        world_size: int = 2,
+        min_replicas: int = 1,
+        step_time_s: float = 0.0,
+    ) -> None:
+        self.replica_idx = replica_idx
+        self.lighthouse_addr = lighthouse_addr
+        self.injector = injector
+        self.num_steps = num_steps
+        self.world_size = world_size
+        self.min_replicas = min_replicas
+        self.step_time_s = step_time_s
+        self.fake_comm = None
+        self.restarts = 0
+        self._zombies: List[Manager] = []
+        self._dead_stores: List[object] = []
+
+    def run_group(self) -> List[dict]:
+        while True:
+            try:
+                return self._group_main()
+            except InjectedFailure:
+                self.restarts += 1
+                logger.info("group %d restarting", self.replica_idx)
+                while self._zombies:
+                    try:
+                        self._zombies.pop().shutdown()
+                    except Exception:  # noqa: BLE001
+                        pass
+                continue
+
+    def cleanup(self) -> None:
+        while self._zombies:
+            try:
+                self._zombies.pop().shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        while self._dead_stores:
+            try:
+                self._dead_stores.pop().shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _group_main(self) -> List[dict]:
+        from torchft_tpu.store import StoreServer
+
+        store = StoreServer("127.0.0.1:0")
+        self._dead_stores.append(store)
+        with ThreadPoolExecutor(
+            max_workers=self.world_size,
+            thread_name_prefix=f"group{self.replica_idx}",
+        ) as pool:
+            futures = [
+                pool.submit(self._rank_main, rank, store.port)
+                for rank in range(self.world_size)
+            ]
+            results = [f.result(timeout=60.0) for f in futures]
+        return results
+
+    def _rank_main(self, rank: int, store_port: int) -> dict:
+        import time as _time
+
+        comm = TCPCommunicator(timeout_s=10.0)
+        # rank r of every group starts from the same seed; ranks differ
+        params = _init_state(seed=1000 + rank)
+        tx = optax.sgd(0.05, momentum=0.9)
+        holder = {"params": params, "opt_state": tx.init(params)}
+
+        manager = Manager(
+            comm=comm,
+            load_state_dict=lambda s: holder.update(s),
+            state_dict=lambda: dict(holder),
+            min_replica_size=self.min_replicas,
+            use_async_quorum=True,
+            replica_id=f"mr_replica_{self.replica_idx}",
+            lighthouse_addr=self.lighthouse_addr,
+            store_addr="127.0.0.1",
+            store_port=store_port,
+            rank=rank,
+            world_size=self.world_size,
+            timeout=10.0,
+            quorum_timeout=10.0,
+            connect_timeout=10.0,
+        )
+        self._zombies.append(manager)
+        opt = OptimizerWrapper(manager, tx)
+
+        while manager.current_step() < self.num_steps:
+            self.injector.check(self, rank, manager.current_step())
+            if self.step_time_s:
+                _time.sleep(self.step_time_s)
+            opt.start_step()
+            scale = 0.01 * (self.replica_idx + 1) * (rank + 1)
+            grads = jax.tree_util.tree_map(
+                lambda p: jnp.full_like(p, scale), holder["params"]
+            )
+            grads = ft_allreduce(manager, grads)
+            opt.step(holder, grads)
+        return jax.tree_util.tree_map(np.asarray, dict(holder))
+
+
+def _run_groups(groups: List[MultiRankRunner]) -> List[List[dict]]:
+    try:
+        with ThreadPoolExecutor(max_workers=len(groups)) as pool:
+            futures = [pool.submit(g.run_group) for g in groups]
+            return [f.result(timeout=120.0) for f in futures]
+    finally:
+        for g in groups:
+            g.cleanup()
+
+
+def test_multi_rank_groups_healthy(lighthouse) -> None:
+    """2 replica groups x 2 ranks: the intra-group barrier forwards ONE
+    lighthouse request per group, per-rank rings average, states match
+    rank-wise across groups."""
+    groups = [
+        MultiRankRunner(
+            i, lighthouse.local_address(), EventInjector(), num_steps=5
+        )
+        for i in range(2)
+    ]
+    states = _run_groups(groups)
+    assert all(g.restarts == 0 for g in groups)
+    for rank in range(2):
+        _assert_all_equal([states[0][rank], states[1][rank]])
+    # ranks hold distinct slices: rank states must differ within a group
+    assert not np.allclose(states[0][0]["params"]["w"], states[0][1]["params"]["w"])
+
+
+def test_multi_rank_groups_recovery(lighthouse) -> None:
+    """Whole-group kill at step 2 (all ranks fail together, the multi-host
+    failure unit); the group restarts, every rank heals from its twin in
+    the survivor, rank-wise states converge."""
+    injector = EventInjector()
+    injector.fail_at(replica=0, step=2)  # keyed by RANK within group 1
+    injector.fail_at(replica=1, step=2)
+    groups = [
+        MultiRankRunner(
+            0, lighthouse.local_address(), EventInjector(), num_steps=12,
+            step_time_s=0.05,
+        ),
+        MultiRankRunner(
+            1, lighthouse.local_address(), injector, num_steps=12,
+            step_time_s=0.05,
+        ),
+    ]
+    states = _run_groups(groups)
+    assert injector.count == 2
+    assert groups[1].restarts == 1
+    for rank in range(2):
+        _assert_all_equal([states[0][rank], states[1][rank]])

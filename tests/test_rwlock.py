@@ -82,3 +82,42 @@ def test_concurrent_stress() -> None:
         t.join()
     assert not errors
     assert state["v"] == 400
+
+
+def test_timed_out_wait_rechecks_predicate() -> None:
+    """A notify racing the deadline must not produce a spurious
+    TimeoutError when the lock became available (ADVICE r1)."""
+    lock = RWLock(timeout=5.0)
+    lock.r_lock()  # predicate blocked for a writer
+
+    orig_wait = lock._cond.wait
+
+    def wait_times_out_but_lock_freed(timeout=None):
+        # simulate: the reader released exactly as our wait timed out
+        lock._readers = 0
+        return False
+
+    lock._cond.wait = wait_times_out_but_lock_freed  # type: ignore[assignment]
+    try:
+        guard = lock.w_lock(timeout=0.2)  # must acquire, not raise
+    finally:
+        lock._cond.wait = orig_wait  # type: ignore[assignment]
+    guard.__exit__(None, None, None)
+
+
+def test_timed_out_wait_rechecks_predicate_reader() -> None:
+    lock = RWLock(timeout=5.0)
+    lock.w_lock()
+
+    orig_wait = lock._cond.wait
+
+    def wait_times_out_but_lock_freed(timeout=None):
+        lock._writer = False
+        return False
+
+    lock._cond.wait = wait_times_out_but_lock_freed  # type: ignore[assignment]
+    try:
+        guard = lock.r_lock(timeout=0.2)
+    finally:
+        lock._cond.wait = orig_wait  # type: ignore[assignment]
+    guard.__exit__(None, None, None)

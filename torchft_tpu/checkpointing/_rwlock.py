@@ -35,10 +35,14 @@ class RWLock:
                 try:
                     while self._writer or self._readers > 0:
                         remaining = deadline - time.monotonic()
-                        if remaining <= 0 or not self._cond.wait(remaining):
+                        if remaining <= 0:
                             raise TimeoutError(
                                 f"could not acquire write lock in {budget}s"
                             )
+                        # a timed-out wait falls through to re-check the
+                        # guard once more before the deadline check raises —
+                        # a notify racing the deadline must not lose
+                        self._cond.wait(remaining)
                     self._writer = True
                 finally:
                     self._writers_waiting -= 1
@@ -48,8 +52,9 @@ class RWLock:
             else:
                 while self._writer or self._writers_waiting > 0:
                     remaining = deadline - time.monotonic()
-                    if remaining <= 0 or not self._cond.wait(remaining):
+                    if remaining <= 0:
                         raise TimeoutError(f"could not acquire read lock in {budget}s")
+                    self._cond.wait(remaining)
                 self._readers += 1
 
     def r_lock(self, timeout: Optional[float] = None) -> "_Guard":

@@ -62,8 +62,10 @@ class CommTransport(CheckpointTransport[T]):
     @staticmethod
     def _tags(step: int) -> int:
         # wide per-step strides: even million-leaf state dicts can't bleed
-        # into the next step's tag range
-        return _TAG_BASE * 1000 + (step % 8) * 10_000_000
+        # into the next step's tag range.  Salted by the FULL step (tags are
+        # uint64 on both tiers) so a transfer stale by any number of steps
+        # can never alias a newer one.
+        return _TAG_BASE * 1000 + step * 10_000_000
 
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: T, timeout: float

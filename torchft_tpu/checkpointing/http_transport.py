@@ -19,6 +19,7 @@ import io
 import logging
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, TypeVar
 from urllib.request import urlopen
@@ -152,18 +153,27 @@ class HTTPTransport(CheckpointTransport[T]):
 
         chunks: List[Optional[bytes]] = [None] * total
         chunks[0] = first
+        errors: List[BaseException] = []
 
         def _fetch(i: int) -> None:
-            with urlopen(f"{base}/{i}", timeout=timeout) as r:
-                chunks[i] = r.read()
+            try:
+                with urlopen(f"{base}/{i}", timeout=timeout) as r:
+                    chunks[i] = r.read()
+            except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+                errors.append(e)
 
         threads = [
             threading.Thread(target=_fetch, args=(i,)) for i in range(1, total)
         ]
+        deadline = time.monotonic() + timeout
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=timeout)
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if errors:
+            # a real fetch failure (404/refused) must not masquerade as a
+            # timeout
+            raise errors[0]
         if any(c is None for c in chunks):
             raise TimeoutError("chunked checkpoint fetch timed out")
         return loads_pytree(b"".join(chunks))  # type: ignore[arg-type]

@@ -56,6 +56,42 @@ class _ArrayPlaceholder:
     shape: Tuple[int, ...]
 
 
+def shard_key(index: Tuple, shape: Tuple[int, ...]) -> Tuple:
+    """Canonical, host-order-independent key for a shard's global index
+    (a tuple of resolved ``(start, stop, step)`` per dimension)."""
+    key = []
+    for dim, sl in enumerate(index):
+        if isinstance(sl, slice):
+            key.append(sl.indices(shape[dim]))
+        else:  # integer index
+            key.append((int(sl), int(sl) + 1, 1))
+    return tuple(key)
+
+
+@dataclass
+class _ShardedArrayPlaceholder:
+    """Skeleton marker for a non-fully-addressable jax Array: this HOST's
+    unique shards ride as separate payload arrays keyed by global index."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+    entries: List[Tuple[Tuple, _ArrayPlaceholder]]
+
+
+@dataclass
+class ShardedHostArray:
+    """Host-local deserialized form of a multi-host (non-fully-addressable)
+    jax Array: shard data keyed by canonical global index.  Convert back to
+    a device array with ``torchft_tpu.ddp.restore_like`` against an existing
+    array that carries the target sharding — sender host h and receiver
+    host h address identical regions (same mesh + specs across replica
+    groups), so the keys match exactly."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+    shards: dict  # shard_key -> np.ndarray
+
+
 def _is_array_leaf(x: Any) -> bool:
     if isinstance(x, np.ndarray):
         return True
@@ -63,9 +99,34 @@ def _is_array_leaf(x: Any) -> bool:
     return type(x).__module__.startswith("jax") and hasattr(x, "__array__")
 
 
+def _is_multihost_jax_array(x: Any) -> bool:
+    return (
+        type(x).__module__.startswith("jax")
+        and hasattr(x, "is_fully_addressable")
+        and not x.is_fully_addressable
+    )
+
+
 def _extract_arrays(obj: Any, arrays: List[np.ndarray]) -> Any:
     """Deep-copy the container skeleton, swapping array leaves for
     placeholders (handles dict/list/tuple; other types pickle as-is)."""
+    if _is_multihost_jax_array(obj):
+        # ship only this host's unique addressable shards; the receiving
+        # twin host reassembles them into its identical sharding layout
+        shape = tuple(obj.shape)
+        unique: dict = {}
+        for s in obj.addressable_shards:
+            unique.setdefault(shard_key(s.index, shape), s)
+        entries: List[Tuple[Tuple, _ArrayPlaceholder]] = []
+        for k in sorted(unique):
+            arr = np.asarray(unique[k].data)
+            entries.append(
+                (k, _ArrayPlaceholder(index=len(arrays), dtype=arr.dtype.name, shape=arr.shape))
+            )
+            arrays.append(arr)
+        return _ShardedArrayPlaceholder(
+            shape=shape, dtype=obj.dtype.name, entries=entries
+        )
     if _is_array_leaf(obj):
         arr = np.asarray(obj)
         # dtype.name (not .str) so extension dtypes like bfloat16 round-trip
@@ -90,6 +151,12 @@ def _extract_arrays(obj: Any, arrays: List[np.ndarray]) -> Any:
 def _restore_arrays(obj: Any, arrays: List[np.ndarray]) -> Any:
     if isinstance(obj, _ArrayPlaceholder):
         return arrays[obj.index]
+    if isinstance(obj, _ShardedArrayPlaceholder):
+        return ShardedHostArray(
+            shape=obj.shape,
+            dtype=obj.dtype,
+            shards={k: arrays[ph.index] for k, ph in obj.entries},
+        )
     if isinstance(obj, dict):
         return {k: _restore_arrays(v, arrays) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -139,6 +206,9 @@ def load_pytree(stream: BinaryIO) -> Any:
     def _collect(obj: Any) -> None:
         if isinstance(obj, _ArrayPlaceholder):
             placeholders[obj.index] = obj
+        elif isinstance(obj, _ShardedArrayPlaceholder):
+            for _, ph in obj.entries:
+                placeholders[ph.index] = ph
         elif isinstance(obj, dict):
             for v in obj.values():
                 _collect(v)

@@ -20,6 +20,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchft_tpu.checkpointing.serialization import (
+    ShardedHostArray,
+    shard_key as _shard_key,
+)
 from torchft_tpu.manager import Manager
 from torchft_tpu.work import DummyWork, Work
 
@@ -55,9 +59,60 @@ def allreduce_pytree_result(tree: Any) -> Work:
     return DummyWork(tree)
 
 
-def _to_host(leaf: Any) -> np.ndarray:
-    # np.asarray on a jax.Array device_gets; numpy passes through
-    return np.asarray(leaf)
+def _host_contribution(leaf: Any) -> Tuple[np.ndarray, Any]:
+    """This host's flat (1-D) contribution to the replica-dim average, plus
+    a ``restore(avg_flat) -> leaf`` function.
+
+    Fully-addressable leaves ship whole.  For multi-host arrays (a replica
+    group spanning hosts, the v5p reality) each host ships only its UNIQUE
+    addressable shards: host h of every replica group addresses the same
+    logical region (identical mesh + shardings across groups), so
+    shard-local averaging over the per-``group_rank`` DCN ring is exact —
+    same math, sharded bytes.  Restore rebuilds the global array from
+    per-device buffers without ever materializing it unsharded.
+    """
+    if not isinstance(leaf, jax.Array) or leaf.is_fully_addressable:
+        arr = np.asarray(leaf)
+        shape, is_jax = arr.shape, isinstance(leaf, jax.Array)
+        sharding = leaf.sharding if is_jax else None
+
+        def _restore_full(avg_flat: np.ndarray) -> Any:
+            host_val = avg_flat.reshape(shape)
+            if is_jax:
+                return jax.device_put(host_val, sharding)
+            return host_val
+
+        return arr.reshape(-1), _restore_full
+
+    shards = list(leaf.addressable_shards)
+    # deterministic order shared by this host's twin in every replica group;
+    # replicated shards (same global index on several local devices) ship once
+    unique: Dict[Tuple, Any] = {}
+    for s in shards:
+        unique.setdefault(_shard_key(s.index, leaf.shape), s)
+    keys = sorted(unique)
+    segments: List[np.ndarray] = []
+    offsets: Dict[Tuple, Tuple[int, int, tuple]] = {}
+    off = 0
+    for k in keys:
+        data = np.asarray(unique[k].data)
+        offsets[k] = (off, data.size, data.shape)
+        segments.append(data.reshape(-1))
+        off += data.size
+    flat = np.concatenate(segments) if segments else np.empty(0, leaf.dtype)
+    shape, sharding, dtype = leaf.shape, leaf.sharding, leaf.dtype
+
+    def _restore_sharded(avg_flat: np.ndarray) -> Any:
+        per_device = []
+        for s in shards:
+            o, n, shp = offsets[_shard_key(s.index, shape)]
+            buf = avg_flat[o : o + n].reshape(shp).astype(dtype)
+            per_device.append(jax.device_put(buf, s.device))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, per_device
+        )
+
+    return flat, _restore_sharded
 
 
 def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False) -> Work:
@@ -79,7 +134,12 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
     if not leaves:
         return allreduce_pytree_result(tree)
 
-    if should_quantize and all(isinstance(l, jax.Array) for l in leaves):
+    if should_quantize and all(
+        isinstance(l, jax.Array) and l.is_fully_addressable for l in leaves
+    ):
+        # (multi-host arrays fall through to the bucketed path, which ships
+        # shard-local contributions; int8 wire quantization still applies
+        # via manager.allreduce(should_quantize=True))
         # Quantize ON DEVICE (Pallas on TPU): only int8 payload + rowwise
         # scales cross HBM→host→DCN — ~4x fewer bytes than shipping floats
         # and quantizing host-side.
@@ -105,7 +165,19 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
     order: Dict[str, List[int]] = {}
     leaf_bytes: List[int] = []
     for i, leaf in enumerate(leaves):
-        if hasattr(leaf, "dtype") and hasattr(leaf, "nbytes"):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            # bucket by what actually crosses the wire: this host's unique
+            # shard bytes (identical on twin hosts, so bucket boundaries —
+            # and therefore ring frame sizes — stay uniform)
+            dtype_name = leaf.dtype.name
+            seen = set()
+            nbytes = 0
+            for s in leaf.addressable_shards:
+                k = _shard_key(s.index, leaf.shape)
+                if k not in seen:
+                    seen.add(k)
+                    nbytes += int(s.data.nbytes)
+        elif hasattr(leaf, "dtype") and hasattr(leaf, "nbytes"):
             dtype_name, nbytes = leaf.dtype.name, int(leaf.nbytes)
         else:
             arr = np.asarray(leaf)
@@ -129,15 +201,16 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
             groups.append(group)
 
         for group in groups:
-            host = [_to_host(leaves[i]) for i in group]  # waits async copies
-            total = sum(a.size for a in host)
-            flat = np.empty(total, dtype=host[0].dtype)
+            # waits async copies; sharded leaves contribute local shards only
+            contribs = [_host_contribution(leaves[i]) for i in group]
+            total = sum(c[0].size for c in contribs)
+            flat = np.empty(total, dtype=contribs[0][0].dtype)
             layout = []
             off = 0
-            for i, arr in zip(group, host):
+            for i, (arr, restore) in zip(group, contribs):
                 n = arr.size
-                flat[off : off + n] = arr.reshape(-1)
-                layout.append((i, off, n, arr.shape))
+                flat[off : off + n] = arr
+                layout.append((i, off, n, restore))
                 off += n
             # submit immediately: this bucket's ring overlaps the next
             # bucket's fetch/assembly
@@ -150,16 +223,8 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
         out = list(original)
         for work, layout in zip(works, bucket_layouts):
             flat = work.wait()
-            for i, off, n, shape in layout:
-                host_val = flat[off : off + n].reshape(shape)
-                leaf = original[i]
-                if isinstance(leaf, jax.Array):
-                    out[i] = jax.device_put(
-                        host_val,
-                        leaf.sharding if hasattr(leaf, "sharding") else None,
-                    )
-                else:
-                    out[i] = host_val
+            for i, off, n, restore in layout:
+                out[i] = restore(flat[off : off + n])
         return jax.tree_util.tree_unflatten(treedef, out)
 
     fut: "Future[Any]" = Future()
@@ -174,7 +239,12 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
     threading.Thread(
         target=_finish, name="tpuft_ddp_gather", daemon=True
     ).start()
-    return Work(fut)
+    out = Work(fut)
+    # fence the WHOLE pipeline (including restore/device_put) at commit, not
+    # just the wire collectives — a restore failure after the vote would
+    # otherwise apply unaveraged gradients on this replica only
+    manager._register_pending(out)
+    return out
 
 
 @jax.jit
@@ -214,9 +284,11 @@ def _allreduce_pytree_device_quantized(
             off += n
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    return manager.wrap_work(
+    out = manager.wrap_work(
         work.then(_reassemble), jax.tree_util.tree_unflatten(treedef, leaves)
     )
+    manager._register_pending(out)  # fence reassembly at commit too
+    return out
 
 
 def ft_allreduce(manager: Manager, tree: Any, should_quantize: bool = False) -> Any:
@@ -238,3 +310,38 @@ class DistributedDataParallel:
 
     def average_gradients_async(self, grads: Any, should_quantize: bool = False) -> Work:
         return allreduce_pytree(self.manager, grads, should_quantize)
+
+
+def restore_like(new: Any, old: Any) -> Any:
+    """Place one healed host-side leaf back on device in ``old``'s layout.
+
+    ``new`` is what the checkpoint transport delivered: a numpy array, or a
+    :class:`ShardedHostArray` when the sender was a multi-host replica group
+    (its host shipped only its addressable shards — which are exactly the
+    shards THIS host addresses, since mesh + shardings are identical across
+    replica groups).
+    """
+    if isinstance(new, ShardedHostArray):
+        assert isinstance(old, jax.Array), "sharded leaf healed into non-jax leaf"
+        per_device = []
+        for s in old.addressable_shards:
+            k = _shard_key(s.index, old.shape)
+            buf = np.asarray(new.shards[k]).astype(old.dtype)
+            per_device.append(jax.device_put(buf, s.device))
+        return jax.make_array_from_single_device_arrays(
+            old.shape, old.sharding, per_device
+        )
+    if isinstance(old, jax.Array):
+        return jax.device_put(np.asarray(new), old.sharding)
+    return new
+
+
+def restore_tree_like(new_tree: Any, old_tree: Any) -> Any:
+    """``restore_like`` over a pytree (``ShardedHostArray`` leaves kept
+    atomic)."""
+    return jax.tree_util.tree_map(
+        restore_like,
+        new_tree,
+        old_tree,
+        is_leaf=lambda x: isinstance(x, ShardedHostArray),
+    )

@@ -283,7 +283,10 @@ class LighthouseServer:
         included = {m.replica_id for m in quorum.participants}
         for member in self._parked.values():
             if member.replica_id not in included:
-                self._register(member)
+                # NOT an implicit heartbeat: a replica that died while its
+                # request was parked must age out on the normal heartbeat
+                # timeout, not stay "alive" until its request deadline
+                self._register(member, refresh_heartbeat=False)
         self._generation += 1
         self._lock.notify_all()
 
@@ -346,9 +349,12 @@ class LighthouseServer:
             except OSError:
                 pass
 
-    def _register(self, requester: QuorumMember) -> None:
+    def _register(
+        self, requester: QuorumMember, refresh_heartbeat: bool = True
+    ) -> None:
         now = time.monotonic()
-        self._state.heartbeats[requester.replica_id] = now  # implicit heartbeat
+        if refresh_heartbeat:
+            self._state.heartbeats[requester.replica_id] = now  # implicit heartbeat
         self._state.participants[requester.replica_id] = _MemberDetails(
             joined=now, member=requester
         )

@@ -165,6 +165,12 @@ class Manager:
         self._errored: Optional[ExceptionWithTraceback] = None
         self._recovery_event: Optional[Event] = None
 
+        # outstanding Works issued this step via allreduce/
+        # allreduce_prequantized; fenced at should_commit (the analog of the
+        # reference's accelerator-stream synchronize, ``manager.py:888-893``)
+        self._pending_works: List[Work] = []
+        self._pending_works_lock = threading.Lock()
+
         self._step = 0
         self._batches_committed = 0
         self._commit_failures = 0
@@ -357,6 +363,9 @@ class Manager:
 
         self._errored = None
         self._healing = False
+        # drop stale works from a step the caller abandoned without voting
+        with self._pending_works_lock:
+            self._pending_works.clear()
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -597,7 +606,9 @@ class Manager:
                     return _div(value, num_participants)
                 return [_div(a, num_participants) for a in cast(list, value)]
 
-            return self.wrap_work(work.then(_normalize), data)
+            wrapped = self.wrap_work(work.then(_normalize), data)
+            self._register_pending(wrapped)
+            return wrapped
         except Exception as e:  # noqa: BLE001
             self._logger.exception(f"got exception in all reduce -- skipping remaining: {e}")
             self.report_error(e)
@@ -647,7 +658,32 @@ class Manager:
         threading.Thread(
             target=_run, name="tpuft_prequantized_allreduce", daemon=True
         ).start()
-        return Work(fut)
+        out = Work(fut)
+        self._register_pending(out)
+        return out
+
+    def _register_pending(self, work: Work) -> None:
+        with self._pending_works_lock:
+            self._pending_works.append(work)
+
+    def _fence_pending_works(self) -> None:
+        """Wait every collective issued this step before voting: a failure
+        landing after the vote would otherwise let this replica commit with
+        its own unaveraged gradients (error-funnel substitution) while peers
+        commit averaged ones — silent cross-replica divergence.  Analog of
+        the reference's stream synchronize (``manager.py:888-893``)."""
+        import time as _time
+
+        with self._pending_works_lock:
+            pending, self._pending_works = self._pending_works, []
+        deadline = _time.monotonic() + self._timeout  # one shared budget
+        for work in pending:
+            try:
+                # errors are already swallowed by wrap_work / the funnel;
+                # only a genuine stall can raise (TimeoutError) here
+                work.wait(timeout=max(0.0, deadline - _time.monotonic()))
+            except Exception as e:  # noqa: BLE001
+                self.report_error(e)
 
     # ------------------------------------------------------------------
     # commit
@@ -656,7 +692,8 @@ class Manager:
     @traced("torchft::manager::should_commit")
     def should_commit(self, timeout: Optional[float] = None) -> bool:
         """Vote on committing this step (``manager.py:855-943``)."""
-        # fence recovery before voting
+        # fence all in-flight collectives, then recovery, before voting
+        self._fence_pending_works()
         if self._recovery_event is not None:
             self._recovery_event.synchronize(timeout=self._timeout)
             self._recovery_event = None

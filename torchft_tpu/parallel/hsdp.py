@@ -13,10 +13,14 @@ equivalent:
   the compiled program, so elastic membership can't trigger recompilation
   (SURVEY.md §7 hard part 1).
 
-Single-controller note: each replica group is one process driving its slice;
-``np.asarray`` on a sharded gradient assembles the process's addressable
-shards.  On multi-host slices each host averages only its addressable
-shards — same math, sharded bytes.
+Multi-host note: when a replica group spans hosts (one process per host,
+``group_rank`` = host index), gradients are non-fully-addressable jax
+Arrays.  ``ddp._host_contribution`` ships only this host's unique
+addressable shards over the per-``group_rank`` DCN ring (host h of every
+replica group addresses the same logical region, so shard-local averaging
+is exact) and rebuilds results with
+``jax.make_array_from_single_device_arrays`` — the global array is never
+materialized on one host.
 """
 
 from __future__ import annotations
@@ -72,6 +76,51 @@ def make_grad_step(
         )
 
 
+def sharded_opt_init(tx: Any, params: Any) -> Any:
+    """Initialize optimizer state with correct shardings on multi-host.
+
+    ``jax.jit(tx.init)(params)`` is NOT sharding-safe: optimizer-state
+    leaves depend only on param *shapes*, so XLA dead-code-eliminates the
+    value dependence and is free to pick arbitrary (e.g. single-device)
+    output layouts — on a multi-host mesh that makes heal/update layouts
+    diverge between hosts.  This pins every params-mirroring leaf (momentum,
+    Adam mu/nu, ...) to its param's sharding, matched by key-path suffix
+    (optax embeds the params tree verbatim in those subtrees), and
+    replicates everything else (step counts etc.).
+    """
+    params_paths = {
+        tuple(path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+    mesh = None
+    for leaf in params_paths.values():
+        if isinstance(leaf, jax.Array) and isinstance(leaf.sharding, NamedSharding):
+            mesh = leaf.sharding.mesh
+            break
+
+    shapes = jax.eval_shape(tx.init, params)
+
+    def _sharding_for(path: Tuple, shape_struct: Any) -> Any:
+        path = tuple(path)
+        for start in range(len(path)):
+            suffix = path[start:]
+            param = params_paths.get(suffix)
+            if (
+                isinstance(param, jax.Array)
+                and tuple(param.shape) == tuple(shape_struct.shape)
+            ):
+                return param.sharding
+        if mesh is not None:
+            return NamedSharding(mesh, P())  # replicated (counts, scalars)
+        return None
+
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+    out_shardings = jax.tree_util.tree_unflatten(
+        treedef, [_sharding_for(p, s) for p, s in leaves_with_paths]
+    )
+    return jax.jit(tx.init, out_shardings=out_shardings)(params)
+
+
 def make_update_step(
     model: Any, tx: Any, mesh: Mesh
 ) -> Callable[[Any, Any, Any], Tuple[Any, Any]]:
@@ -113,7 +162,7 @@ class HSDPTrainer:
             assert key is not None, "need key or params"
             params = shard_init(model, key, mesh)
         with mesh:
-            opt_state = jax.jit(tx.init)(params)
+            opt_state = sharded_opt_init(tx, params)
         self.holder: Dict[str, Any] = {"params": params, "opt_state": opt_state}
         self._grad_step = make_grad_step(model, mesh)
         self._update_step = make_update_step(model, tx, mesh)
@@ -126,22 +175,16 @@ class HSDPTrainer:
         return dict(self.holder)
 
     def _load_state(self, state: Dict[str, Any]) -> None:
-        # restore placement: healing delivers host arrays; put them back into
-        # the HSDP layout of the existing values
-        params_like = self.holder["params"]
-        self.holder["params"] = jax.tree_util.tree_map(
-            lambda new, old: jax.device_put(
-                new, old.sharding if isinstance(old, jax.Array) else None
-            ),
-            state["params"],
-            params_like,
+        # restore placement: healing delivers host arrays (or per-shard
+        # ShardedHostArray bundles from a multi-host sender); put them back
+        # into the HSDP layout of the existing values
+        from torchft_tpu.ddp import restore_tree_like
+
+        self.holder["params"] = restore_tree_like(
+            state["params"], self.holder["params"]
         )
-        self.holder["opt_state"] = jax.tree_util.tree_map(
-            lambda new, old: jax.device_put(
-                new, old.sharding if isinstance(old, jax.Array) else None
-            ),
-            state["opt_state"],
-            self.holder["opt_state"],
+        self.holder["opt_state"] = restore_tree_like(
+            state["opt_state"], self.holder["opt_state"]
         )
 
     def train_step(self, batch: Any) -> Tuple[float, bool]:
