@@ -1,0 +1,21 @@
+"""Joint FT x SPMD kill/heal: real TCP replicas, real HSDP meshes.
+
+VERDICT r1 weak #2 / next-#3: the composition of a real DCN-tier
+communicator with compiled mesh parallelism, including a whole-replica
+death and live heal, validated in one run.
+"""
+
+import jax
+import pytest
+
+from torchft_tpu.drill import joint_ft_spmd_drill
+
+
+def test_joint_ft_spmd_kill_heal() -> None:
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    facts = joint_ft_spmd_drill(
+        n_devices=8, num_replicas=2, num_steps=6, kill_replica=1, kill_at_step=2
+    )
+    assert facts["restarts"] == 1
+    assert facts["healed"]

@@ -59,6 +59,34 @@ def allreduce_pytree_result(tree: Any) -> Work:
     return DummyWork(tree)
 
 
+def _unique_local_shards(leaf: Any) -> Dict[Tuple, Any]:
+    """This host's addressable shards deduped by canonical global index
+    (replicated shards — same index on several local devices — appear once),
+    in deterministic key order shared by this host's twin in every replica
+    group."""
+    unique: Dict[Tuple, Any] = {}
+    for s in leaf.addressable_shards:
+        unique.setdefault(_shard_key(s.index, leaf.shape), s)
+    return dict(sorted(unique.items()))
+
+
+def _assemble_sharded(
+    shape: Tuple[int, ...],
+    sharding: Any,
+    dtype: Any,
+    addressable_shards: Any,
+    lookup,
+) -> Any:
+    """Rebuild a (possibly non-fully-addressable) jax Array from host data:
+    ``lookup(shard_key, shard)`` returns the numpy block for that shard.  The
+    global array is never materialized on one host."""
+    per_device = []
+    for s in addressable_shards:
+        buf = np.asarray(lookup(_shard_key(s.index, shape), s)).astype(dtype)
+        per_device.append(jax.device_put(buf, s.device))
+    return jax.make_array_from_single_device_arrays(shape, sharding, per_device)
+
+
 def _host_contribution(leaf: Any) -> Tuple[np.ndarray, Any]:
     """This host's flat (1-D) contribution to the replica-dim average, plus
     a ``restore(avg_flat) -> leaf`` function.
@@ -85,17 +113,12 @@ def _host_contribution(leaf: Any) -> Tuple[np.ndarray, Any]:
         return arr.reshape(-1), _restore_full
 
     shards = list(leaf.addressable_shards)
-    # deterministic order shared by this host's twin in every replica group;
-    # replicated shards (same global index on several local devices) ship once
-    unique: Dict[Tuple, Any] = {}
-    for s in shards:
-        unique.setdefault(_shard_key(s.index, leaf.shape), s)
-    keys = sorted(unique)
+    unique = _unique_local_shards(leaf)
     segments: List[np.ndarray] = []
     offsets: Dict[Tuple, Tuple[int, int, tuple]] = {}
     off = 0
-    for k in keys:
-        data = np.asarray(unique[k].data)
+    for k, s in unique.items():
+        data = np.asarray(s.data)
         offsets[k] = (off, data.size, data.shape)
         segments.append(data.reshape(-1))
         off += data.size
@@ -103,14 +126,11 @@ def _host_contribution(leaf: Any) -> Tuple[np.ndarray, Any]:
     shape, sharding, dtype = leaf.shape, leaf.sharding, leaf.dtype
 
     def _restore_sharded(avg_flat: np.ndarray) -> Any:
-        per_device = []
-        for s in shards:
-            o, n, shp = offsets[_shard_key(s.index, shape)]
-            buf = avg_flat[o : o + n].reshape(shp).astype(dtype)
-            per_device.append(jax.device_put(buf, s.device))
-        return jax.make_array_from_single_device_arrays(
-            shape, sharding, per_device
-        )
+        def _lookup(key: Tuple, _s: Any) -> np.ndarray:
+            o, n, shp = offsets[key]
+            return avg_flat[o : o + n].reshape(shp)
+
+        return _assemble_sharded(shape, sharding, dtype, shards, _lookup)
 
     return flat, _restore_sharded
 
@@ -170,13 +190,9 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
             # shard bytes (identical on twin hosts, so bucket boundaries —
             # and therefore ring frame sizes — stay uniform)
             dtype_name = leaf.dtype.name
-            seen = set()
-            nbytes = 0
-            for s in leaf.addressable_shards:
-                k = _shard_key(s.index, leaf.shape)
-                if k not in seen:
-                    seen.add(k)
-                    nbytes += int(s.data.nbytes)
+            nbytes = sum(
+                int(s.data.nbytes) for s in _unique_local_shards(leaf).values()
+            )
         elif hasattr(leaf, "dtype") and hasattr(leaf, "nbytes"):
             dtype_name, nbytes = leaf.dtype.name, int(leaf.nbytes)
         else:
@@ -323,13 +339,12 @@ def restore_like(new: Any, old: Any) -> Any:
     """
     if isinstance(new, ShardedHostArray):
         assert isinstance(old, jax.Array), "sharded leaf healed into non-jax leaf"
-        per_device = []
-        for s in old.addressable_shards:
-            k = _shard_key(s.index, old.shape)
-            buf = np.asarray(new.shards[k]).astype(old.dtype)
-            per_device.append(jax.device_put(buf, s.device))
-        return jax.make_array_from_single_device_arrays(
-            old.shape, old.sharding, per_device
+        return _assemble_sharded(
+            old.shape,
+            old.sharding,
+            old.dtype,
+            old.addressable_shards,
+            lambda key, _s: new.shards[key],
         )
     if isinstance(old, jax.Array):
         return jax.device_put(np.asarray(new), old.sharding)
