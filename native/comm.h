@@ -13,6 +13,7 @@
 #pragma once
 
 #include <fcntl.h>
+#include <sys/uio.h>
 
 #include <atomic>
 #include <chrono>
@@ -306,11 +307,30 @@ class Communicator {
     for (int64_t step = 0; step < ws - 1; ++step) {
       int64_t send_idx = rank_ - step;
       int64_t recv_idx = rank_ - step - 1;
-      exchange(right, 1000 + step, chunk_ptr(send_idx), chunk_bytes(send_idx),
-               left, 1000 + step, scratch.data(), chunk_bytes(recv_idx),
-               deadline);
-      reduce_buffer(chunk_ptr(recv_idx), scratch.data(), chunk_bytes(recv_idx),
-                    dt, op);
+      // duplex: a sender thread streams our chunk while this thread recvs
+      // the incoming chunk in quanta and reduces each quantum as soon as it
+      // lands — the (memory-bound) reduction rides entirely under the wire
+      int sfd = peer_fd(right);
+      int rfd = peer_fd(left);
+      std::string send_err;
+      std::thread sender([&] {
+        try {
+          send_framed(sfd, right, 1000 + step, chunk_ptr(send_idx),
+                      chunk_bytes(send_idx), deadline);
+        } catch (const std::exception& e) {
+          send_err = e.what();
+        }
+      });
+      try {
+        recv_framed_reduce(rfd, left, 1000 + step, chunk_ptr(recv_idx),
+                           chunk_bytes(recv_idx), scratch.data(), dt, op,
+                           deadline);
+      } catch (...) {
+        sender.join();
+        throw;
+      }
+      sender.join();
+      if (!send_err.empty()) throw CommError(send_err);
     }
     for (int64_t step = 0; step < ws - 1; ++step) {
       int64_t send_idx = rank_ + 1 - step;
@@ -450,8 +470,63 @@ class Communicator {
   void send_framed(int fd, int64_t peer, uint64_t tag, const void* buf,
                    size_t nbytes, TimePoint deadline) {
     uint64_t hdr[2] = {nbytes, tag};
-    send_loop(fd, peer, hdr, 16, deadline);
-    send_loop(fd, peer, buf, nbytes, deadline);
+    // writev: header + first payload bytes leave in ONE syscall/segment
+    // (with TCP_NODELAY a separate 16-byte header send costs a segment and
+    // a wakeup per frame)
+    struct iovec iov[2];
+    iov[0].iov_base = hdr;
+    iov[0].iov_len = 16;
+    iov[1].iov_base = const_cast<void*>(buf);
+    iov[1].iov_len = nbytes;
+    while (true) {
+      check_abort();
+      if (now() > deadline) throw CommError("send timed out");
+      ssize_t sent = ::writev(fd, iov, 2);
+      if (sent < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+          continue;
+        throw CommError("send failed to rank " + std::to_string(peer));
+      }
+      size_t s = static_cast<size_t>(sent);
+      if (s >= iov[0].iov_len + iov[1].iov_len) return;
+      if (s >= iov[0].iov_len) {
+        // header fully out: finish the payload with the plain loop
+        size_t payload_sent = s - iov[0].iov_len;
+        send_loop(fd, peer, static_cast<const uint8_t*>(buf) + payload_sent,
+                  nbytes - payload_sent, deadline);
+        return;
+      }
+      // partial header (rare): finish header then payload
+      send_loop(fd, peer, reinterpret_cast<uint8_t*>(hdr) + s, 16 - s,
+                deadline);
+      send_loop(fd, peer, buf, nbytes, deadline);
+      return;
+    }
+  }
+
+  // recv a frame in quanta, reducing each quantum into `dst` as it arrives
+  // (TCP delivers in order, so progressive reduction needs only a
+  // quantum-sized scratch and overlaps compute with the wire)
+  void recv_framed_reduce(int fd, int64_t peer, uint64_t tag, void* dst,
+                          size_t nbytes, uint8_t* scratch, DType dt, RedOp op,
+                          TimePoint deadline) {
+    static constexpr size_t kQuantum = size_t(4) << 20;
+    uint64_t hdr[2];
+    recv_loop(fd, peer, hdr, 16, deadline);
+    if (hdr[1] != tag)
+      throw CommError("tag mismatch from rank " + std::to_string(peer));
+    if (hdr[0] != nbytes)
+      throw CommError("size mismatch from rank " + std::to_string(peer));
+    size_t esz = dtype_size(dt);
+    size_t quantum = kQuantum - (kQuantum % (esz ? esz : 1));
+    uint8_t* d = static_cast<uint8_t*>(dst);
+    size_t off = 0;
+    while (off < nbytes) {
+      size_t take = std::min(quantum, nbytes - off);
+      recv_loop(fd, peer, scratch, take, deadline);
+      reduce_buffer(d + off, scratch, take, dt, op);
+      off += take;
+    }
   }
 
   void recv_framed(int fd, int64_t peer, uint64_t tag, void* buf,

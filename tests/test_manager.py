@@ -315,7 +315,7 @@ class TestAllreduce:
         from torchft_tpu.work import Work
 
         class SlowFailingCommunicator(DummyCommunicator):
-            def allreduce(self, buffers, op=None):  # type: ignore[override]
+            def allreduce(self, buffers, op=None, in_place=False):  # type: ignore[override]
                 fut: Future = Future()
 
                 def _later() -> None:
@@ -344,7 +344,7 @@ class TestAllreduce:
         from torchft_tpu.work import Work
 
         class SlowCommunicator(DummyCommunicator):
-            def allreduce(self, buffers, op=None):  # type: ignore[override]
+            def allreduce(self, buffers, op=None, in_place=False):  # type: ignore[override]
                 fut: Future = Future()
 
                 def _later() -> None:
@@ -420,3 +420,17 @@ class TestShouldCommit:
         manager2.load_state_dict(sd)
         assert manager2.current_step() == 1
         assert manager2.batches_committed() == 2
+
+
+def test_allreduce_default_does_not_mutate_input() -> None:
+    """Without in_place, caller buffers (e.g. LocalSGD's live host params)
+    must survive the collective unchanged."""
+    client = StubClient()
+    client.quorum_results.append(_quorum_result())
+    manager = _make_manager(client)
+    manager.start_quorum()
+    data = np.full(8, 6.0)
+    keep = data.copy()
+    out = manager.allreduce(data).wait(timeout=5.0)
+    np.testing.assert_array_equal(data, keep)  # input untouched
+    np.testing.assert_array_equal(out, keep / 2)  # AVG over 2 participants

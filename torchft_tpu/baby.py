@@ -228,13 +228,24 @@ class BabyCommunicator(Communicator):
         if err is not None:
             raise CommunicatorError(f"baby configure failed: {err}") from err
 
-    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+    def allreduce(
+        self,
+        buffers: Buffers,
+        op: ReduceOp = ReduceOp.SUM,
+        in_place: bool = False,
+    ) -> Work:
+        # in_place is accepted for interface parity but meaningless across
+        # the subprocess pipe (payloads are pickled both ways)
         return self._submit("allreduce", dict(buffers=buffers, op=op))
 
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
         return self._submit("broadcast", dict(buffers=buffers, root=root))
 
-    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
+    def send_bytes(self, data, dst: int, tag: int = 0) -> Work:
+        # the pipe pickles payloads (copies are inherent to the isolation
+        # tier); memoryviews/arrays must become bytes to cross it
+        if not isinstance(data, bytes):
+            data = bytes(data)
         return self._submit("send_bytes", dict(data=data, dst=dst, tag=tag))
 
     def recv_bytes(self, src: int, tag: int = 0) -> Work:

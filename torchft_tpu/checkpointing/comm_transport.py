@@ -80,9 +80,10 @@ class CommTransport(CheckpointTransport[T]):
             protocol=pickle.HIGHEST_PROTOCOL,
         )
         base = self._tags(step)
-        # materialize each array's bytes ONCE (not per destination) and
-        # submit every send before waiting, so multi-dest heals overlap
-        blobs = [bytes(as_byte_view(arr)) for arr in arrays]
+        # zero-copy: send straight from each array's buffer (one byte view
+        # per array, shared across destinations); submit every send before
+        # waiting, so multi-dest heals overlap
+        blobs = [as_byte_view(arr) for arr in arrays]
         works = []
         for dst in dst_ranks:
             works.append(self._comm.send_bytes(meta, dst, tag=base))

@@ -106,9 +106,20 @@ class Communicator(ABC):
         ...
 
     @abstractmethod
-    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+    def allreduce(
+        self,
+        buffers: Buffers,
+        op: ReduceOp = ReduceOp.SUM,
+        in_place: bool = False,
+    ) -> Work:
         """Reduce ``buffers`` across ranks; the Work's value is the reduced
-        list of arrays (AVG divides by world size)."""
+        list of arrays (AVG divides by world size).
+
+        ``in_place=True`` lets the tier reduce directly in the caller's
+        (contiguous, writable) buffers and return them aliased — c10d
+        allreduce semantics, skipping a full-payload copy.  Only pass it for
+        buffers you own and will not reuse (on error the contents are
+        unspecified; the step is voted down anyway)."""
 
     @abstractmethod
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
@@ -665,13 +676,18 @@ class TCPCommunicator(Communicator):
             return [buffers]
         return [np.asarray(b) for b in buffers]
 
-    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+    def allreduce(
+        self,
+        buffers: Buffers,
+        op: ReduceOp = ReduceOp.SUM,
+        in_place: bool = False,
+    ) -> Work:
         arrays = self._as_list(buffers)
         single = isinstance(buffers, np.ndarray)
 
         def _make(ctx: "_CommCtx") -> Callable[[], object]:
             def _run() -> object:
-                out = _allreduce_sync(ctx, arrays, op)
+                out = _allreduce_sync(ctx, arrays, op, in_place=in_place)
                 return out[0] if single else out
 
             return _run
@@ -691,14 +707,21 @@ class TCPCommunicator(Communicator):
 
         return self._submit(_make)
 
-    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
-        view = memoryview(data)
+    def send_bytes(self, data, dst: int, tag: int = 0) -> Work:
+        """Send any contiguous buffer (bytes, memoryview, numpy array) with
+        no intermediate copy."""
+        if isinstance(data, np.ndarray):
+            view = _bytes_view(np.ascontiguousarray(data))
+        else:
+            view = memoryview(data)
+            if view.format != "B":
+                view = view.cast("B")
 
         def _make(ctx: "_CommCtx") -> Callable[[], object]:
             def _run() -> object:
                 mesh = ctx.require_peer(dst)
                 mesh.exchange([(dst, tag, view)], [], ctx.deadline())
-                return len(view)
+                return view.nbytes
 
             return _run
 
@@ -829,10 +852,21 @@ class _CommCtx:
 
 
 def _allreduce_sync(
-    ctx: _CommCtx, arrays: List[np.ndarray], op: ReduceOp
+    ctx: _CommCtx,
+    arrays: List[np.ndarray],
+    op: ReduceOp,
+    in_place: bool = False,
 ) -> List[np.ndarray]:
     ws = ctx.world_size
-    out = [np.array(a, copy=True) for a in arrays]
+    out = [
+        a
+        if in_place
+        and isinstance(a, np.ndarray)
+        and a.flags.c_contiguous
+        and a.flags.writeable
+        else np.array(a, copy=True)
+        for a in arrays
+    ]
     if ws > 1:
         assert ctx.mesh is not None
         # one flat ring per dtype — concatenating mixed dtypes would silently
@@ -959,14 +993,20 @@ class DummyCommunicator(Communicator):
         self._world_size = world_size
         self.configure_count += 1
 
-    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+    def allreduce(
+        self,
+        buffers: Buffers,
+        op: ReduceOp = ReduceOp.SUM,
+        in_place: bool = False,
+    ) -> Work:
         return DummyWork(buffers)
 
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
         return DummyWork(buffers)
 
-    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
-        return DummyWork(len(data))
+    def send_bytes(self, data, dst: int, tag: int = 0) -> Work:
+        nbytes = data.nbytes if hasattr(data, "nbytes") else len(data)
+        return DummyWork(nbytes)
 
     def recv_bytes(self, src: int, tag: int = 0) -> Work:
         return DummyWork(b"")
@@ -1026,8 +1066,13 @@ class FakeCommunicatorWrapper(Communicator):
         self._errored = None
         self._comm.configure(*args, **kwargs)
 
-    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
-        return self._wrap(self._comm.allreduce(buffers, op))
+    def allreduce(
+        self,
+        buffers: Buffers,
+        op: ReduceOp = ReduceOp.SUM,
+        in_place: bool = False,
+    ) -> Work:
+        return self._wrap(self._comm.allreduce(buffers, op, in_place=in_place))
 
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
         return self._wrap(self._comm.broadcast(buffers, root))
@@ -1082,7 +1127,12 @@ class ManagedCommunicator(Communicator):
     def configure(self, *args, **kwargs) -> None:  # type: ignore[override]
         raise RuntimeError("ManagedCommunicator is configured by its Manager")
 
-    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+    def allreduce(
+        self,
+        buffers: Buffers,
+        op: ReduceOp = ReduceOp.SUM,
+        in_place: bool = False,
+    ) -> Work:
         return self._manager.allreduce(buffers)
 
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:

@@ -82,7 +82,9 @@ def _assemble_sharded(
     global array is never materialized on one host."""
     per_device = []
     for s in addressable_shards:
-        buf = np.asarray(lookup(_shard_key(s.index, shape), s)).astype(dtype)
+        buf = np.asarray(lookup(_shard_key(s.index, shape), s)).astype(
+            dtype, copy=False
+        )
         per_device.append(jax.device_put(buf, s.device))
     return jax.make_array_from_single_device_arrays(shape, sharding, per_device)
 
@@ -229,9 +231,14 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
                 layout.append((i, off, n, restore))
                 off += n
             # submit immediately: this bucket's ring overlaps the next
-            # bucket's fetch/assembly
+            # bucket's fetch/assembly; in_place — the bucket is ours and
+            # discarded after the restore, so the ring reduces straight into
+            # it (no defensive copy; on this host class that copy costs as
+            # much as half the ring itself)
             works.append(
-                manager.allreduce(flat, should_quantize=should_quantize)
+                manager.allreduce(
+                    flat, should_quantize=should_quantize, in_place=True
+                )
             )
             bucket_layouts.append(layout)
 

@@ -185,8 +185,10 @@ class _Fragment:
         local = self._current_local()
         pseudograds = [b - l for b, l in zip(self.backup, local)]
         assert self._work is None, "fragment already has an allreduce in flight"
+        # in_place: pseudograds are freshly computed for this call and only
+        # the returned average is read afterwards
         self._work = self._manager.allreduce(
-            pseudograds, should_quantize=self._should_quantize
+            pseudograds, should_quantize=self._should_quantize, in_place=True
         )
 
     def perform_sync(self) -> bool:

@@ -567,6 +567,7 @@ class Manager:
         self,
         data: Union[np.ndarray, List[np.ndarray]],
         should_quantize: bool = False,
+        in_place: bool = False,
     ) -> Work:
         """Fault-tolerant AVG allreduce of gradients across the participating
         replicas (``manager.py:410-493``).
@@ -575,6 +576,12 @@ class Manager:
         already recorded this step the input is returned unchanged; if this
         replica is not participating (healing/spare) its contribution is
         zeroed and the result is still divided by ``num_participants()``.
+
+        ``in_place=True`` skips the communicator's full-payload defensive
+        copy by reducing directly in ``data``'s buffers — pass it ONLY for
+        buffers you built for this call and will not read afterwards (the
+        ddp bucket path does); buffers that alias live state (LocalSGD's
+        host params) must keep the default.
         """
         if self.errored():
             return DummyWork(data)
@@ -597,7 +604,7 @@ class Manager:
 
                 work = allreduce_quantized(self._comm, data)
             else:
-                work = self._comm.allreduce(data, ReduceOp.SUM)
+                work = self._comm.allreduce(data, ReduceOp.SUM, in_place=in_place)
 
             # AVG = SUM / runtime participant count — replica count is never
             # baked into compiled programs (SURVEY.md §7 hard part 1)

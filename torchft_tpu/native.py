@@ -193,6 +193,21 @@ def available() -> bool:
     return _load() is not None
 
 
+def _buffer_ptr(data) -> Tuple[ctypes.c_void_p, int, object]:
+    """(pointer, nbytes, keepalive) into any contiguous buffer-protocol
+    object with NO copy — the round-1 send path built intermediate ``bytes``
+    objects, a full-payload copy per hop.  ``keepalive`` is the object that
+    actually backs the pointer; the caller must pin it until the op is done
+    (it is ``data`` itself unless a contiguity copy was required)."""
+    if isinstance(data, np.ndarray):
+        arr = data if data.flags.c_contiguous else np.ascontiguousarray(data)
+        return ctypes.c_void_p(arr.ctypes.data), int(arr.nbytes), arr
+    # bytes / bytearray / memoryview / anything buffer-protocol:
+    # np.frombuffer is a zero-copy view into the object's buffer
+    view = np.frombuffer(memoryview(data).cast("B"), dtype=np.uint8)
+    return ctypes.c_void_p(view.ctypes.data), view.size, view
+
+
 def _last_error(lib: ctypes.CDLL) -> str:
     return lib.tpuft_last_error().decode("utf-8", "replace")
 
@@ -518,7 +533,12 @@ class CppCommunicator(Communicator):
             return [buffers]
         return [np.asarray(b) for b in buffers]
 
-    def allreduce(self, buffers: Buffers, op: ReduceOp = ReduceOp.SUM) -> Work:
+    def allreduce(
+        self,
+        buffers: Buffers,
+        op: ReduceOp = ReduceOp.SUM,
+        in_place: bool = False,
+    ) -> Work:
         arrays = self._as_list(buffers)
         single = isinstance(buffers, np.ndarray)
         ws = self._world_size
@@ -534,9 +554,15 @@ class CppCommunicator(Communicator):
                 if code is None:
                     raise CommunicatorError(f"unsupported dtype {dtype_name}")
                 if len(idxs) == 1:
-                    # single-buffer fast path: copy once (the native op is
-                    # in-place), no concatenate
-                    flat = np.array(arrays[idxs[0]], copy=True).reshape(-1)
+                    a = arrays[idxs[0]]
+                    if in_place and a.flags.c_contiguous and a.flags.writeable:
+                        # zero-copy: the native ring reduces straight into
+                        # the caller's buffer (returned aliased)
+                        flat = a.reshape(-1)
+                    else:
+                        # the native op is in-place; copy once to preserve
+                        # the caller's buffer
+                        flat = np.array(a, copy=True).reshape(-1)
                 else:
                     flat = np.concatenate(
                         [np.ascontiguousarray(arrays[i]).reshape(-1) for i in idxs]
@@ -590,13 +616,19 @@ class CppCommunicator(Communicator):
 
         return self._submit(_run)
 
-    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
-        def _run() -> object:
+    def send_bytes(self, data, dst: int, tag: int = 0) -> Work:
+        """Send any contiguous buffer (bytes, memoryview, numpy array)
+        WITHOUT copying: the C call reads straight from the object's buffer
+        (the closure keeps it alive until the op completes)."""
+        ptr, nbytes, keepalive = _buffer_ptr(data)
+
+        def _run(_keep=keepalive) -> object:
+            # _keep pins the backing buffer for the C call's lifetime
             self._check(
-                self._lib.tpuft_comm_send(self._h, data, len(data), dst, tag),
+                self._lib.tpuft_comm_send(self._h, ptr, nbytes, dst, tag),
                 "send",
             )
-            return len(data)
+            return nbytes
 
         return self._submit(_run)
 
