@@ -133,6 +133,18 @@ int tpuft_comm_allreduce(void* h, void* data, uint64_t nbytes, int32_t dtype,
   });
 }
 
+int tpuft_comm_reduce_scatter(void* h, void* data, uint64_t nbytes,
+                              int32_t dtype, int32_t op, void* out,
+                              uint64_t out_cap, uint64_t* out_bytes) {
+  auto* comm = static_cast<tpuft::Communicator*>(h);
+  return guarded([&] {
+    *out_bytes = comm->reduce_scatter(data, nbytes,
+                                      static_cast<tpuft::DType>(dtype),
+                                      static_cast<tpuft::RedOp>(op), out,
+                                      out_cap);
+  });
+}
+
 int tpuft_comm_broadcast(void* h, void* data, uint64_t nbytes, int64_t root) {
   auto* comm = static_cast<tpuft::Communicator*>(h);
   return guarded([&] { comm->broadcast(data, nbytes, root); });

@@ -280,65 +280,35 @@ class Communicator {
   void allreduce(void* data, size_t nbytes, DType dt, RedOp op) {
     if (world_size_ <= 1) return;
     size_t esz = dtype_size(dt);
-    size_t n = nbytes / esz;
-    int64_t ws = world_size_;
-    int64_t right = (rank_ + 1) % ws;
-    int64_t left = (rank_ - 1 + ws) % ws;
     auto deadline = deadline_in(timeout_s_);
-
-    // element bounds per chunk
-    std::vector<size_t> bounds(ws + 1, 0);
-    size_t base = n / ws, extra = n % ws;
-    for (int64_t i = 0; i < ws; ++i)
-      bounds[i + 1] = bounds[i] + base + (static_cast<size_t>(i) < extra ? 1 : 0);
-
+    auto bounds = ring_bounds(nbytes / esz);
     uint8_t* bytes = static_cast<uint8_t*>(data);
-    std::vector<uint8_t> scratch((base + (extra ? 1 : 0)) * esz);
 
-    auto chunk_ptr = [&](int64_t i) {
-      i = ((i % ws) + ws) % ws;
-      return bytes + bounds[i] * esz;
-    };
-    auto chunk_bytes = [&](int64_t i) {
-      i = ((i % ws) + ws) % ws;
-      return (bounds[i + 1] - bounds[i]) * esz;
-    };
+    // reduce-scatter phase with shift 0: rank ends owning chunk rank+1
+    ring_reduce_phase(bytes, bounds, esz, dt, op, /*shift=*/0, deadline);
+    // allgather phase with matching shift: first step sends the owned chunk
+    ring_allgather_phase(bytes, bounds, esz, /*shift=*/0, deadline);
+  }
 
-    for (int64_t step = 0; step < ws - 1; ++step) {
-      int64_t send_idx = rank_ - step;
-      int64_t recv_idx = rank_ - step - 1;
-      // duplex: a sender thread streams our chunk while this thread recvs
-      // the incoming chunk in quanta and reduces each quantum as soon as it
-      // lands — the (memory-bound) reduction rides entirely under the wire
-      int sfd = peer_fd(right);
-      int rfd = peer_fd(left);
-      std::string send_err;
-      std::thread sender([&] {
-        try {
-          send_framed(sfd, right, 1000 + step, chunk_ptr(send_idx),
-                      chunk_bytes(send_idx), deadline);
-        } catch (const std::exception& e) {
-          send_err = e.what();
-        }
-      });
-      try {
-        recv_framed_reduce(rfd, left, 1000 + step, chunk_ptr(recv_idx),
-                           chunk_bytes(recv_idx), scratch.data(), dt, op,
-                           deadline);
-      } catch (...) {
-        sender.join();
-        throw;
-      }
-      sender.join();
-      if (!send_err.empty()) throw CommError(send_err);
+  // reduce-scatter: `data` is reduced in place ring-wise; this rank's chunk
+  // (chunk `rank` of ws near-equal chunks over the flattened elements) ends
+  // up fully reduced and is copied into `out`.  Returns the chunk's bytes.
+  size_t reduce_scatter(void* data, size_t nbytes, DType dt, RedOp op,
+                        void* out, size_t out_cap) {
+    size_t esz = dtype_size(dt);
+    auto bounds = ring_bounds(nbytes / esz);
+    uint8_t* bytes = static_cast<uint8_t*>(data);
+    size_t own_off = bounds[rank_] * esz;
+    size_t own_bytes = (bounds[rank_ + 1] - bounds[rank_]) * esz;
+    if (own_bytes > out_cap)
+      throw CommError("reduce_scatter out buffer too small");
+    if (world_size_ > 1) {
+      auto deadline = deadline_in(timeout_s_);
+      // shift -1: rank ends owning chunk `rank` (conventional contract)
+      ring_reduce_phase(bytes, bounds, esz, dt, op, /*shift=*/-1, deadline);
     }
-    for (int64_t step = 0; step < ws - 1; ++step) {
-      int64_t send_idx = rank_ + 1 - step;
-      int64_t recv_idx = rank_ - step;
-      exchange(right, 2000 + step, chunk_ptr(send_idx), chunk_bytes(send_idx),
-               left, 2000 + step, chunk_ptr(recv_idx), chunk_bytes(recv_idx),
-               deadline);
-    }
+    std::memcpy(out, bytes + own_off, own_bytes);
+    return own_bytes;
   }
 
   void broadcast(void* data, size_t nbytes, int64_t root) {
@@ -501,6 +471,86 @@ class Communicator {
                 deadline);
       send_loop(fd, peer, buf, nbytes, deadline);
       return;
+    }
+  }
+
+  // element bounds per ring chunk (first n%ws chunks one element longer)
+  std::vector<size_t> ring_bounds(size_t n) const {
+    int64_t ws = world_size_;
+    std::vector<size_t> bounds(ws + 1, 0);
+    size_t base = n / ws, extra = n % ws;
+    for (int64_t i = 0; i < ws; ++i)
+      bounds[i + 1] =
+          bounds[i] + base + (static_cast<size_t>(i) < extra ? 1 : 0);
+    return bounds;
+  }
+
+  // ring reduce phase: ws-1 duplex steps; with shift s, this rank ends up
+  // owning the fully-reduced chunk (rank + 1 + s) mod ws.  The (memory-
+  // bound) reduction rides under the wire via quantum-pipelined recv.
+  void ring_reduce_phase(uint8_t* bytes, const std::vector<size_t>& bounds,
+                         size_t esz, DType dt, RedOp op, int64_t shift,
+                         TimePoint deadline) {
+    int64_t ws = world_size_;
+    int64_t right = (rank_ + 1) % ws;
+    int64_t left = (rank_ - 1 + ws) % ws;
+    std::vector<uint8_t> scratch((bounds[1] - bounds[0]) * esz);
+    auto chunk_ptr = [&](int64_t i) {
+      i = ((i % ws) + ws) % ws;
+      return bytes + bounds[i] * esz;
+    };
+    auto chunk_bytes = [&](int64_t i) {
+      i = ((i % ws) + ws) % ws;
+      return (bounds[i + 1] - bounds[i]) * esz;
+    };
+    for (int64_t step = 0; step < ws - 1; ++step) {
+      int64_t send_idx = rank_ - step + shift;
+      int64_t recv_idx = rank_ - step - 1 + shift;
+      int sfd = peer_fd(right);
+      int rfd = peer_fd(left);
+      std::string send_err;
+      std::thread sender([&] {
+        try {
+          send_framed(sfd, right, 1000 + step, chunk_ptr(send_idx),
+                      chunk_bytes(send_idx), deadline);
+        } catch (const std::exception& e) {
+          send_err = e.what();
+        }
+      });
+      try {
+        recv_framed_reduce(rfd, left, 1000 + step, chunk_ptr(recv_idx),
+                           chunk_bytes(recv_idx), scratch.data(), dt, op,
+                           deadline);
+      } catch (...) {
+        sender.join();
+        throw;
+      }
+      sender.join();
+      if (!send_err.empty()) throw CommError(send_err);
+    }
+  }
+
+  // ring allgather phase: ws-1 duplex steps circulating the fully-reduced
+  // chunks; with shift s, rank starts owning chunk (rank + 1 + s) mod ws.
+  void ring_allgather_phase(uint8_t* bytes, const std::vector<size_t>& bounds,
+                            size_t esz, int64_t shift, TimePoint deadline) {
+    int64_t ws = world_size_;
+    int64_t right = (rank_ + 1) % ws;
+    int64_t left = (rank_ - 1 + ws) % ws;
+    auto chunk_ptr = [&](int64_t i) {
+      i = ((i % ws) + ws) % ws;
+      return bytes + bounds[i] * esz;
+    };
+    auto chunk_bytes = [&](int64_t i) {
+      i = ((i % ws) + ws) % ws;
+      return (bounds[i + 1] - bounds[i]) * esz;
+    };
+    for (int64_t step = 0; step < ws - 1; ++step) {
+      int64_t send_idx = rank_ + 1 + shift - step;
+      int64_t recv_idx = rank_ + shift - step;
+      exchange(right, 2000 + step, chunk_ptr(send_idx), chunk_bytes(send_idx),
+               left, 2000 + step, chunk_ptr(recv_idx), chunk_bytes(recv_idx),
+               deadline);
     }
   }
 

@@ -319,3 +319,46 @@ def test_fake_wrapper_error_injection() -> None:
     np.testing.assert_allclose(
         comm.allreduce(np.ones(2, dtype=np.float32)).wait(), np.ones(2)
     )
+
+
+@pytest.mark.parametrize("world_size", [1, 2, 3])
+def test_reduce_scatter(store, world_size) -> None:
+    n = 1000  # not divisible by 3 -> uneven chunks
+
+    def _fn(comm, rank):
+        data = np.arange(n, dtype=np.float32) + rank
+        return comm.reduce_scatter(data, ReduceOp.SUM).wait(timeout=30.0)
+
+    results = _run_ranks(store, world_size, _fn)
+    expected = sum(np.arange(n, dtype=np.float32) + r for r in range(world_size))
+    base, extra = divmod(n, world_size)
+    off = 0
+    for rank, res in enumerate(results):
+        size = base + (1 if rank < extra else 0)
+        np.testing.assert_allclose(res, expected[off : off + size], rtol=1e-6)
+        off += size
+    assert off == n
+
+
+def test_reduce_scatter_avg(store) -> None:
+    world_size = 2
+    n = 64
+
+    def _fn(comm, rank):
+        data = np.full(n, float(rank + 1), dtype=np.float32)
+        return comm.reduce_scatter(data, ReduceOp.AVG).wait(timeout=30.0)
+
+    results = _run_ranks(store, world_size, _fn)
+    for res in results:
+        np.testing.assert_allclose(res, 1.5)
+
+
+def test_reduce_scatter_does_not_mutate_input(store) -> None:
+    def _fn(comm, rank):
+        data = np.full(10, float(rank), dtype=np.float32)
+        keep = data.copy()
+        comm.reduce_scatter(data, ReduceOp.SUM).wait(timeout=30.0)
+        np.testing.assert_array_equal(data, keep)
+        return True
+
+    assert all(_run_ranks(store, 2, _fn))

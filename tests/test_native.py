@@ -287,6 +287,31 @@ class TestCppCommunicator:
         for res in results:
             np.testing.assert_allclose(res, expected, rtol=1e-6)
 
+    @pytest.mark.parametrize("world_size", [1, 2, 3])
+    def test_reduce_scatter(self, cpp_store, world_size) -> None:
+        n = 1000  # not divisible by 3 -> uneven chunks
+
+        def _fn(comm, rank):
+            data = np.arange(n, dtype=np.float32) + rank
+            keep = data.copy()
+            out = comm.reduce_scatter(data, ReduceOp.SUM).wait(timeout=30.0)
+            np.testing.assert_array_equal(data, keep)  # input untouched
+            return out
+
+        results = _run_ranks(cpp_store, world_size, _fn)
+        expected = sum(
+            np.arange(n, dtype=np.float32) + r for r in range(world_size)
+        )
+        base, extra = divmod(n, world_size)
+        off = 0
+        for rank, res in enumerate(results):
+            size = base + (1 if rank < extra else 0)
+            np.testing.assert_allclose(
+                res, expected[off : off + size], rtol=1e-6
+            )
+            off += size
+        assert off == n
+
     def test_allreduce_bf16_and_avg(self, cpp_store) -> None:
         import ml_dtypes
 

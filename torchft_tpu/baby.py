@@ -80,6 +80,10 @@ def _worker_main(cmd_pipe, out_pipe, backend: str, timeout_s: float) -> None:
                 result = comm.recv_bytes(args["src"], args["tag"]).wait(
                     timeout=timeout_s
                 )
+            elif op == "reduce_scatter":
+                result = comm.reduce_scatter(args["data"], args["op"]).wait(
+                    timeout=timeout_s
+                )
             elif op == "barrier":
                 result = comm.barrier().wait(timeout=timeout_s)
             else:
@@ -240,6 +244,9 @@ class BabyCommunicator(Communicator):
 
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
         return self._submit("broadcast", dict(buffers=buffers, root=root))
+
+    def reduce_scatter(self, data: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._submit("reduce_scatter", dict(data=data, op=op))
 
     def send_bytes(self, data, dst: int, tag: int = 0) -> Work:
         # the pipe pickles payloads (copies are inherent to the isolation

@@ -148,6 +148,18 @@ class Communicator(ABC):
         contiguous writable array); the Work's value is the payload size."""
         raise NotImplementedError
 
+    def reduce_scatter(
+        self, data: np.ndarray, op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        """Reduce ``data`` (same shape on every rank) across ranks and
+        scatter: the Work's value is THIS rank's chunk of the flattened
+        reduction (chunk r of ``world_size`` near-equal chunks, the first
+        ``n % ws`` chunks one element longer).  Half the wire cost of a full
+        allreduce when each rank only needs its own slice — the reference
+        carries the same op on its PG surface (``process_group.py:236-276``).
+        """
+        raise NotImplementedError
+
     @abstractmethod
     def abort(self, reason: str = "aborted") -> None:
         ...
@@ -707,6 +719,29 @@ class TCPCommunicator(Communicator):
 
         return self._submit(_make)
 
+    def reduce_scatter(
+        self, data: np.ndarray, op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        arr = np.asarray(data)
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                ws = ctx.world_size
+                flat = np.array(arr, copy=True).reshape(-1)
+                own = _ring_reduce_scatter(ctx, flat, op, tag_base=30_000)
+                if op == ReduceOp.AVG:
+                    if np.issubdtype(own.dtype, np.integer):
+                        own //= ws
+                    else:
+                        np.divide(own, ws, out=own)
+                # compact: own is a view of the full-size working copy;
+                # returning it would pin all n elements for the Work's life
+                return own.copy()
+
+            return _run
+
+        return self._submit(_make)
+
     def send_bytes(self, data, dst: int, tag: int = 0) -> Work:
         """Send any contiguous buffer (bytes, memoryview, numpy array) with
         no intermediate copy."""
@@ -898,6 +933,50 @@ def _allreduce_sync(
     return out
 
 
+def _ring_bounds(n: int, ws: int) -> List[int]:
+    bounds = [0]
+    base, extra = divmod(n, ws)
+    for i in range(ws):
+        bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+    return bounds
+
+
+def _ring_reduce_scatter(
+    ctx: _CommCtx, flat: np.ndarray, op: ReduceOp, tag_base: int = 0
+) -> np.ndarray:
+    """In-place ring reduce-scatter phase: after ws-1 duplex steps, this
+    rank's chunk (``_ring_bounds`` chunk ``rank``) holds the full reduction;
+    returns a view of it.  The schedule is shifted by one vs the textbook
+    ring so rank r ends up owning chunk r (the conventional contract)."""
+    ws, rank = ctx.world_size, ctx.rank
+    if ws == 1:
+        return flat
+    mesh = ctx.mesh
+    assert mesh is not None
+    right = (rank + 1) % ws
+    left = (rank - 1) % ws
+    deadline = ctx.deadline()
+    bounds = _ring_bounds(flat.size, ws)
+
+    def chunk(i: int) -> np.ndarray:
+        i %= ws
+        return flat[bounds[i] : bounds[i + 1]]
+
+    scratch = np.empty(bounds[1], dtype=flat.dtype)
+    for step in range(ws - 1):
+        send_idx = (rank - step - 1) % ws
+        recv_idx = (rank - step - 2) % ws
+        send_chunk = chunk(send_idx)
+        recv_buf = scratch[: chunk(recv_idx).size]
+        mesh.exchange(
+            [(right, tag_base + 1000 + step, _bytes_view(send_chunk))],
+            [(left, tag_base + 1000 + step, _bytes_view(recv_buf))],
+            deadline,
+        )
+        _reduce_into(op, chunk(recv_idx), recv_buf)
+    return chunk(rank)
+
+
 def _ring_allreduce(
     ctx: _CommCtx, flat: np.ndarray, op: ReduceOp, tag_base: int = 0
 ) -> None:
@@ -914,32 +993,17 @@ def _ring_allreduce(
     left = (rank - 1) % ws
     deadline = ctx.deadline()
 
-    bounds = [0]
-    base, extra = divmod(flat.size, ws)
-    for i in range(ws):
-        bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+    _ring_reduce_scatter(ctx, flat, op, tag_base)
+    bounds = _ring_bounds(flat.size, ws)
 
     def chunk(i: int) -> np.ndarray:
         i %= ws
         return flat[bounds[i] : bounds[i + 1]]
 
-    scratch = np.empty(base + (1 if extra else 0), dtype=flat.dtype)
-
+    # allgather phase: rank r starts owning reduced chunk r
     for step in range(ws - 1):
         send_idx = (rank - step) % ws
         recv_idx = (rank - step - 1) % ws
-        send_chunk = chunk(send_idx)
-        recv_buf = scratch[: chunk(recv_idx).size]
-        mesh.exchange(
-            [(right, tag_base + 1000 + step, _bytes_view(send_chunk))],
-            [(left, tag_base + 1000 + step, _bytes_view(recv_buf))],
-            deadline,
-        )
-        _reduce_into(op, chunk(recv_idx), recv_buf)
-
-    for step in range(ws - 1):
-        send_idx = (rank + 1 - step) % ws
-        recv_idx = (rank - step) % ws
         mesh.exchange(
             [(right, tag_base + 2000 + step, _bytes_view(chunk(send_idx)))],
             [(left, tag_base + 2000 + step, _bytes_view(chunk(recv_idx)))],
@@ -1003,6 +1067,13 @@ class DummyCommunicator(Communicator):
 
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
         return DummyWork(buffers)
+
+    def reduce_scatter(
+        self, data: np.ndarray, op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        flat = np.asarray(data).reshape(-1)
+        bounds = _ring_bounds(flat.size, self._world_size)
+        return DummyWork(flat[bounds[self._rank] : bounds[self._rank + 1]])
 
     def send_bytes(self, data, dst: int, tag: int = 0) -> Work:
         nbytes = data.nbytes if hasattr(data, "nbytes") else len(data)
@@ -1077,6 +1148,11 @@ class FakeCommunicatorWrapper(Communicator):
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
         return self._wrap(self._comm.broadcast(buffers, root))
 
+    def reduce_scatter(
+        self, data: np.ndarray, op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        return self._wrap(self._comm.reduce_scatter(data, op))
+
     def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
         return self._wrap(self._comm.send_bytes(data, dst, tag))
 
@@ -1137,6 +1213,11 @@ class ManagedCommunicator(Communicator):
 
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
         return self._manager._comm.broadcast(buffers, root)
+
+    def reduce_scatter(
+        self, data: np.ndarray, op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        return self._manager._comm.reduce_scatter(data, op)
 
     def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
         return self._manager._comm.send_bytes(data, dst, tag)

@@ -139,6 +139,16 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,
             ctypes.c_int32,
         ]
+        lib.tpuft_comm_reduce_scatter.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         lib.tpuft_comm_broadcast.argtypes = [
             ctypes.c_void_p,
             ctypes.c_void_p,
@@ -191,6 +201,14 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def _data_ptr(arr: np.ndarray) -> ctypes.c_void_p:
+    """C pointer to a contiguous array's data; extension dtypes (bfloat16)
+    reject .ctypes on some views, so reinterpret through uint8."""
+    if arr.dtype.name == "bfloat16":
+        return arr.view(np.uint8).ctypes.data_as(ctypes.c_void_p)
+    return arr.ctypes.data_as(ctypes.c_void_p)
 
 
 def _buffer_ptr(data) -> Tuple[ctypes.c_void_p, int, object]:
@@ -569,13 +587,7 @@ class CppCommunicator(Communicator):
                     )
                 self._check(
                     self._lib.tpuft_comm_allreduce(
-                        self._h,
-                        flat.ctypes.data_as(ctypes.c_void_p)
-                        if flat.dtype.name != "bfloat16"
-                        else flat.view(np.uint8).ctypes.data_as(ctypes.c_void_p),
-                        flat.nbytes,
-                        code,
-                        _OP_CODES[op],
+                        self._h, _data_ptr(flat), flat.nbytes, code, _OP_CODES[op]
                     ),
                     "allreduce",
                 )
@@ -590,6 +602,47 @@ class CppCommunicator(Communicator):
                     out[i] = flat[off : off + n].reshape(arrays[i].shape)
                     off += n
             return out[0] if single else out
+
+        return self._submit(_run)
+
+    def reduce_scatter(
+        self, data: np.ndarray, op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        arr = np.asarray(data)
+        ws = self._world_size
+
+        def _run() -> object:
+            code = _DTYPE_CODES.get(arr.dtype.name)
+            if code is None:
+                raise CommunicatorError(f"unsupported dtype {arr.dtype.name}")
+            # the native op reduces in place; work on a copy so the caller's
+            # buffer survives
+            flat = np.array(arr, copy=True).reshape(-1)
+            n = flat.size
+            base, extra = divmod(n, ws)
+            own_elems = base + (1 if self._rank < extra else 0)
+            out = np.empty(own_elems, dtype=flat.dtype)
+            got = ctypes.c_uint64()
+            self._check(
+                self._lib.tpuft_comm_reduce_scatter(
+                    self._h,
+                    _data_ptr(flat),
+                    flat.nbytes,
+                    code,
+                    _OP_CODES[op],
+                    _data_ptr(out),
+                    out.nbytes,
+                    ctypes.byref(got),
+                ),
+                "reduce_scatter",
+            )
+            assert got.value == out.nbytes, "reduce_scatter size mismatch"
+            if op == ReduceOp.AVG:
+                if np.issubdtype(out.dtype, np.integer):
+                    out //= ws
+                else:
+                    np.divide(out, ws, out=out)
+            return out
 
         return self._submit(_run)
 
