@@ -11,6 +11,7 @@
 #include "comm.h"
 #include "lighthouse.h"
 #include "manager.h"
+#include "quant.h"
 #include "store.h"
 
 namespace {
@@ -197,5 +198,27 @@ void tpuft_comm_abort(void* h) {
 }
 
 void tpuft_comm_free(void* h) { delete static_cast<tpuft::Communicator*>(h); }
+
+// ---------------- quantization kernels ----------------
+
+int tpuft_quantize_rowwise(const float* in, int64_t n, int64_t row_size,
+                           int8_t* q, float* scales) {
+  return guarded(
+      [&] { tpuft::quant::quantize_rowwise(in, n, row_size, q, scales); });
+}
+
+int tpuft_dequantize_rowwise(const int8_t* q, const float* scales, int64_t n,
+                             int64_t row_size, float* out) {
+  return guarded(
+      [&] { tpuft::quant::dequantize_rowwise(q, scales, n, row_size, out); });
+}
+
+int tpuft_reduce_rowwise(const int8_t* qs, const float* scales, int64_t w,
+                         int64_t rows, int64_t row_size, int8_t* q_out,
+                         float* s_out) {
+  return guarded([&] {
+    tpuft::quant::reduce_rowwise(qs, scales, w, rows, row_size, q_out, s_out);
+  });
+}
 
 }  // extern "C"

@@ -1,0 +1,137 @@
+// Rowwise int8 quantization kernels for the host/DCN collective tier.
+//
+// The reference fuses fp8 quantize/dequantize/reduce into triton kernels
+// (torchft/quantization.py:44-686, CUDA).  On TPU the device twin is the
+// Pallas kernel (torchft_tpu/ops/pallas_quant.py); these are the HOST
+// kernels used by the DCN pipeline (torchft_tpu/collectives.py) — the
+// numpy versions make several full passes over the buffer and allocate
+// temporaries, which dominates the quantized allreduce at DiLoCo sizes.
+// Here each row is processed in one pass (absmax, then scale+round) with
+// -march=native autovectorization, parallelized over row blocks.
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace tpuft {
+namespace quant {
+
+// Parallel-for over [0, n) in contiguous blocks; plain threads (no pool):
+// kernels run a handful of times per sync, thread spawn cost is noise next
+// to the memory traffic.
+template <typename F>
+inline void parallel_rows(int64_t n, F&& f) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t workers = std::min<int64_t>(hw ? hw : 4, 16);
+  // small inputs: not worth spawning
+  if (n < workers * 8) {
+    f(0, n);
+    return;
+  }
+  int64_t per = (n + workers - 1) / workers;
+  std::vector<std::thread> threads;
+  threads.reserve(workers);
+  for (int64_t w = 0; w < workers; ++w) {
+    int64_t lo = w * per, hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    threads.emplace_back([lo, hi, &f] { f(lo, hi); });
+  }
+  for (auto& t : threads) t.join();
+}
+
+// flat float32 [n] -> q int8 [rows, row_size] (tail zero-padded), scales
+// float32 [rows]; rows = ceil(n / row_size).  scale = absmax/127 per row.
+inline void quantize_rowwise(const float* in, int64_t n, int64_t row_size,
+                             int8_t* q, float* scales) {
+  int64_t rows = std::max<int64_t>(1, (n + row_size - 1) / row_size);
+  parallel_rows(rows, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      int64_t start = r * row_size;
+      int64_t valid = std::max<int64_t>(
+          0, std::min<int64_t>(row_size, n - start));
+      const float* src = in + start;
+      float absmax = 0.f;
+      for (int64_t i = 0; i < valid; ++i) {
+        float a = std::fabs(src[i]);
+        if (a > absmax) absmax = a;
+      }
+      float scale = absmax / 127.0f;
+      scales[r] = scale;
+      float inv = scale > 0.f ? 1.0f / scale : 0.f;
+      int8_t* dst = q + r * row_size;
+      for (int64_t i = 0; i < valid; ++i) {
+        float v = src[i] * inv;
+        v = v > 127.f ? 127.f : (v < -127.f ? -127.f : v);
+        dst[i] = static_cast<int8_t>(std::nearbyintf(v));
+      }
+      if (valid < row_size)
+        std::memset(dst + valid, 0, static_cast<size_t>(row_size - valid));
+    }
+  });
+}
+
+// q int8 [rows, row_size], scales [rows] -> out float32 [n]
+inline void dequantize_rowwise(const int8_t* q, const float* scales,
+                               int64_t n, int64_t row_size, float* out) {
+  int64_t rows = std::max<int64_t>(1, (n + row_size - 1) / row_size);
+  parallel_rows(rows, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      int64_t start = r * row_size;
+      int64_t valid = std::max<int64_t>(
+          0, std::min<int64_t>(row_size, n - start));
+      float scale = scales[r];
+      const int8_t* src = q + r * row_size;
+      float* dst = out + start;
+      for (int64_t i = 0; i < valid; ++i)
+        dst[i] = static_cast<float>(src[i]) * scale;
+    }
+  });
+}
+
+// qs int8 [w, rows, row_size], scales [w, rows] -> requantized sum
+// (q_out [rows, row_size], s_out [rows]).  Dequant-sum-requant per row in
+// one pass with a stack accumulator row (the fused_reduce analog).
+inline void reduce_rowwise(const int8_t* qs, const float* scales, int64_t w,
+                           int64_t rows, int64_t row_size, int8_t* q_out,
+                           float* s_out) {
+  parallel_rows(rows, [&](int64_t lo, int64_t hi) {
+    std::vector<float> acc(static_cast<size_t>(row_size));
+    for (int64_t r = lo; r < hi; ++r) {
+      float* a = acc.data();
+      {
+        const int8_t* src = qs + r * row_size;
+        float s = scales[r];
+        for (int64_t i = 0; i < row_size; ++i)
+          a[i] = static_cast<float>(src[i]) * s;
+      }
+      for (int64_t k = 1; k < w; ++k) {
+        const int8_t* src = qs + (k * rows + r) * row_size;
+        float s = scales[k * rows + r];
+        for (int64_t i = 0; i < row_size; ++i)
+          a[i] += static_cast<float>(src[i]) * s;
+      }
+      float absmax = 0.f;
+      for (int64_t i = 0; i < row_size; ++i) {
+        float v = std::fabs(a[i]);
+        if (v > absmax) absmax = v;
+      }
+      float scale = absmax / 127.0f;
+      s_out[r] = scale;
+      float inv = scale > 0.f ? 1.0f / scale : 0.f;
+      int8_t* dst = q_out + r * row_size;
+      for (int64_t i = 0; i < row_size; ++i) {
+        float v = a[i] * inv;
+        v = v > 127.f ? 127.f : (v < -127.f ? -127.f : v);
+        dst[i] = static_cast<int8_t>(std::nearbyintf(v));
+      }
+    }
+  });
+}
+
+}  // namespace quant
+}  // namespace tpuft

@@ -173,3 +173,114 @@ def test_recv_bytes_into_zero_copy(store) -> None:
 
     results = _run_ranks(store, world_size, _fn)
     np.testing.assert_array_equal(results[1], payload)
+
+
+class TestFp8Wire:
+    def test_roundtrip_accuracy(self) -> None:
+        from torchft_tpu.quantization import (
+            FP8,
+            dequantize_rowwise,
+            quantize_rowwise,
+        )
+
+        rng = np.random.default_rng(3)
+        flat = rng.normal(size=5000).astype(np.float32)
+        q, scales = quantize_rowwise(flat, row_size=256, kind=FP8)
+        assert q.dtype.itemsize == 1 and q.dtype != np.int8
+        restored = dequantize_rowwise(q, scales, flat.size, np.float32)
+        # fp8e4m3 has 3 mantissa bits: relative error ~6% near the top of
+        # the scale, better below
+        np.testing.assert_allclose(
+            restored, flat, atol=np.abs(flat).max() * 0.07
+        )
+
+    def test_reduce_fp8(self) -> None:
+        from torchft_tpu.quantization import (
+            FP8,
+            dequantize_rowwise,
+            quantize_rowwise,
+            reduce_quantized,
+        )
+
+        rng = np.random.default_rng(4)
+        originals = [rng.normal(size=512).astype(np.float32) for _ in range(3)]
+        qs, scs = [], []
+        for o in originals:
+            q, s = quantize_rowwise(o, row_size=128, kind=FP8)
+            qs.append(q)
+            scs.append(s)
+        q_red, s_red = reduce_quantized(np.stack(qs), np.stack(scs), kind=FP8)
+        total = dequantize_rowwise(q_red, s_red, 512, np.float32)
+        np.testing.assert_allclose(total, np.sum(originals, axis=0), atol=0.5)
+
+
+@pytest.mark.parametrize("kind", ["int8", "fp8"])
+def test_allreduce_quantized_fp8_wire(store, kind) -> None:
+    world_size = 2
+    rng = np.random.default_rng(11)
+    inputs = [rng.normal(size=3000).astype(np.float32) for _ in range(world_size)]
+    expected = np.sum(inputs, axis=0)
+
+    def _fn(comm, rank):
+        return allreduce_quantized(comm, inputs[rank].copy(), kind=kind).wait(
+            timeout=30.0
+        )
+
+    results = _run_ranks(store, world_size, _fn)
+    scale = np.abs(expected).max()
+    for res in results:
+        np.testing.assert_allclose(res, expected, atol=0.1 * scale)
+        np.testing.assert_array_equal(res, results[0])
+
+
+@pytest.mark.parametrize("world_size", [2, 3])
+def test_allreduce_quantized_pipelined_windows(
+    store, world_size, monkeypatch
+) -> None:
+    """Force many small windows so the deterministic a2a/ag interleave is
+    exercised (several collectives in flight per call)."""
+    monkeypatch.setenv("TORCHFT_QUANT_WINDOW_MB", "0.01")  # 10 rows/window
+    rng = np.random.default_rng(13)
+    n = 64 * 1024  # 64 rows of 1024 -> ~7 windows
+    inputs = [rng.normal(size=n).astype(np.float32) for _ in range(world_size)]
+    expected = np.sum(inputs, axis=0)
+
+    def _fn(comm, rank):
+        return allreduce_quantized(comm, inputs[rank].copy()).wait(timeout=30.0)
+
+    results = _run_ranks(store, world_size, _fn)
+    scale = np.abs(expected).max()
+    for res in results:
+        np.testing.assert_allclose(res, expected, atol=0.05 * scale)
+        np.testing.assert_array_equal(res, results[0])
+
+
+def test_reduce_quantized_device_matches_host() -> None:
+    """The fused Pallas reduce (jnp fallback off-TPU) must agree with the
+    host numpy reduce up to requantization rounding."""
+    from torchft_tpu.ops.pallas_quant import BLOCK_ROWS, reduce_quantized_device
+    from torchft_tpu.quantization import dequantize_rowwise
+
+    rng = np.random.default_rng(17)
+    w, rows, row_size = 3, BLOCK_ROWS * 2, 256
+    originals = [
+        rng.normal(size=rows * row_size).astype(np.float32) for _ in range(w)
+    ]
+    qs, scs = [], []
+    for o in originals:
+        q, s = quantize_int8_rowwise(o, row_size=row_size)
+        qs.append(q)
+        scs.append(s)
+    qs_np, scs_np = np.stack(qs), np.stack(scs)
+
+    q_host, s_host = reduce_quantized(qs_np, scs_np)
+    q_dev, s_dev = reduce_quantized_device(qs_np, scs_np[:, :, None])
+    total_host = dequantize_rowwise(q_host, s_host, rows * row_size, np.float32)
+    total_dev = dequantize_rowwise(
+        np.asarray(q_dev), np.asarray(s_dev).reshape(-1), rows * row_size, np.float32
+    )
+    # both requantize the same float32 sum; row scales are identical, q may
+    # differ by 1 ulp from rounding-mode differences
+    np.testing.assert_allclose(s_host, np.asarray(s_dev).reshape(-1), rtol=1e-6)
+    step = s_host.max()
+    np.testing.assert_allclose(total_dev, total_host, atol=1.01 * step)

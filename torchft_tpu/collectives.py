@@ -1,4 +1,4 @@
-"""Quantized collectives: int8 allreduce over the replica dimension.
+"""Quantized collectives: int8/fp8 allreduce over the replica dimension.
 
 The reference pipeline (``torchft/collectives.py:297-415``): quantize →
 ``alltoall`` chunks → local dequant-reduce-requant → allgather → dequant.
@@ -6,16 +6,29 @@ Per-rank bytes drop from ~2·n·4 (f32 ring) to ~2·n·1 + scales — the win th
 makes DiLoCo pseudogradient syncs viable over DCN bandwidth
 (``local_sgd.py`` ``should_quantize``).
 
-Like the reference (which chains the pipeline on a side CUDA stream,
-``collectives.py:369-415``), the pipeline here runs off-thread and returns a
-pending Work, so DiLoCo's τ-delay actually overlaps the sync with training.
+Two overlap mechanisms (the analog of the reference chaining its pipeline on
+a side CUDA stream, ``collectives.py:369-415``):
 
-This is the host/DCN tier in numpy; the device-side quantize kernel (cutting
-HBM→host transfer to a quarter) is ``torchft_tpu.ops.pallas_quant``.
+- the whole pipeline runs off-thread and returns a pending Work, so DiLoCo's
+  τ-delay actually overlaps the sync with training;
+- within the pipeline, the buffer is split into fixed-size row windows
+  walked in a deterministic schedule — ``a2a(0), a2a(1), ag(0), a2a(2),
+  ag(1), …`` — so while the op thread drives window ``w+1``'s alltoall and
+  window ``w-1``'s allgather over the wire, the caller thread
+  dequant-sum-requants window ``w``.  The schedule is identical on every
+  rank (the op queue executes in submission order and frames are
+  tag-checked), so windows can never cross.
+
+The reduce step runs on device when a TPU is present (fused Pallas
+dequant-sum-requant, ``ops/pallas_quant.py reduce_quantized_device`` — the
+twin of the reference's ``fused_reduce_fp8``, ``quantization.py:638``): the
+host round-trips int8 shards only, never float32.  Elsewhere it runs as
+vectorized numpy.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import Future
 from typing import List, Optional, Tuple, Union
@@ -25,37 +38,115 @@ import numpy as np
 from torchft_tpu.communicator import Communicator
 from torchft_tpu.quantization import (
     DEFAULT_ROW_SIZE,
-    dequantize_int8_rowwise,
-    quantize_int8_rowwise,
+    FP8,
+    INT8,
+    dequantize_rowwise,
+    quantize_rowwise,
     reduce_quantized,
+    wire_dtype,
 )
 from torchft_tpu.work import DummyWork, Work
 
 Buffers = Union[np.ndarray, List[np.ndarray]]
 
+# Rows per pipeline window are sized so one window's payload is about this
+# many bytes; smaller windows overlap wire and reduce at finer grain but pay
+# more per-frame overhead.
+WINDOW_MB_ENV = "TORCHFT_QUANT_WINDOW_MB"
+DEFAULT_WINDOW_MB = 4.0
+
+# Device-side fused reduce: "1" forces on, "0" forces off, unset/auto uses
+# the TPU when present and the window is big enough to amortize transfers.
+DEVICE_REDUCE_ENV = "TORCHFT_QUANT_DEVICE_REDUCE"
+_DEVICE_REDUCE_MIN_BYTES = 256 << 10
+
+
+def _window_rows(row_size: int) -> int:
+    try:
+        mb = float(os.environ.get(WINDOW_MB_ENV, "") or DEFAULT_WINDOW_MB)
+    except ValueError:
+        mb = DEFAULT_WINDOW_MB
+    return max(1, int(mb * (1 << 20)) // row_size)
+
+
+def _kind_of(q: np.ndarray) -> str:
+    return INT8 if q.dtype == np.int8 else FP8
+
+
+def _use_device_reduce(shard_bytes: int) -> bool:
+    mode = os.environ.get(DEVICE_REDUCE_ENV, "")
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    try:
+        import jax
+
+        return (
+            jax.default_backend() == "tpu"
+            and shard_bytes >= _DEVICE_REDUCE_MIN_BYTES
+        )
+    except Exception:  # pragma: no cover - jax is a hard dependency
+        return False
+
 
 def _pack(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
     """Payload + scales in one uint8 buffer so one collective carries both."""
-    return np.concatenate([q.reshape(-1).view(np.uint8), scales.view(np.uint8)])
+    return np.concatenate(
+        [np.ascontiguousarray(q).reshape(-1).view(np.uint8), scales.view(np.uint8)]
+    )
 
 
-def _unpack(buf: np.ndarray, rows: int, row_size: int) -> Tuple[np.ndarray, np.ndarray]:
+def _unpack(
+    buf: np.ndarray, rows: int, row_size: int, kind: str
+) -> Tuple[np.ndarray, np.ndarray]:
     payload = rows * row_size
     return (
-        buf[:payload].view(np.int8).reshape(rows, row_size),
+        buf[:payload].view(wire_dtype(kind)).reshape(rows, row_size),
         buf[payload:].view(np.float32),
     )
 
 
+def _reduce_shards(
+    qs: np.ndarray, scs: np.ndarray, kind: str
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Dequant-sum-requant ``w`` shards; on a TPU the int8 path runs as the
+    fused Pallas kernel so only 1-byte payloads cross HBM."""
+    if kind == INT8 and _use_device_reduce(qs[0].nbytes):
+        import jax
+
+        from torchft_tpu.ops.pallas_quant import BLOCK_ROWS, reduce_quantized_device
+
+        w, rows, row_size = qs.shape
+        pad = (-rows) % BLOCK_ROWS
+        if pad:
+            qs = np.concatenate(
+                [qs, np.zeros((w, pad, row_size), np.int8)], axis=1
+            )
+            scs = np.concatenate([scs, np.zeros((w, pad), np.float32)], axis=1)
+        q_dev, s_dev = reduce_quantized_device(
+            jax.numpy.asarray(qs), jax.numpy.asarray(scs)[:, :, None]
+        )
+        q_host = np.asarray(q_dev)[:rows]
+        s_host = np.asarray(s_dev).reshape(-1)[:rows]
+        return q_host, s_host
+    return reduce_quantized(qs, scs, kind)
+
+
+# ---------------------------------------------------------------------------
+# single-window core (shared with reduce_scatter and kept as the fallback)
+# ---------------------------------------------------------------------------
+
+
 def _quantized_reduce_scatter_sync(
-    comm: Communicator, flat: np.ndarray, row_size: int, tag: int
+    comm: Communicator, flat: np.ndarray, row_size: int, tag: int, kind: str = INT8
 ) -> Tuple[np.ndarray, np.ndarray, int, int]:
     """Core shared by both quantized collectives: quantize, pad rows to an
     equal per-rank share, alltoall, dequant-sum-requant our shard.
 
     Returns (reduced q shard, its scales, total unpadded rows, rows/rank).
     """
-    q, scales = quantize_int8_rowwise(flat, row_size)
+    q, scales = quantize_rowwise(flat, row_size, kind)
     return _prequantized_reduce_scatter_sync(comm, q, scales, tag)
 
 
@@ -63,14 +154,17 @@ def _prequantized_reduce_scatter_sync(
     comm: Communicator, q: np.ndarray, scales: np.ndarray, tag: int
 ) -> Tuple[np.ndarray, np.ndarray, int, int]:
     """Same core for input already quantized (e.g. on-device by the Pallas
-    kernel, so only int8+scales ever crossed HBM→host)."""
+    kernel, so only 1-byte payload + scales ever crossed HBM→host)."""
+    kind = _kind_of(q)
     ws = comm.size()
     row_size = q.shape[1]
     rows = q.shape[0]
     rows_per_rank = -(-rows // ws)
     padded_rows = rows_per_rank * ws
     if padded_rows != rows:
-        q = np.concatenate([q, np.zeros((padded_rows - rows, row_size), np.int8)])
+        q = np.concatenate(
+            [q, np.zeros((padded_rows - rows, row_size), q.dtype)]
+        )
         scales = np.concatenate(
             [scales, np.zeros(padded_rows - rows, np.float32)]
         )
@@ -84,46 +178,9 @@ def _prequantized_reduce_scatter_sync(
     ]
     gathered = comm.alltoall(chunks, tag=tag).wait()
 
-    qs, scs = zip(*(_unpack(g, rows_per_rank, row_size) for g in gathered))
-    q_red, s_red = reduce_quantized(np.stack(qs), np.stack(scs))
+    qs, scs = zip(*(_unpack(g, rows_per_rank, row_size, kind) for g in gathered))
+    q_red, s_red = _reduce_shards(np.stack(qs), np.stack(scs), kind)
     return q_red, s_red, rows, rows_per_rank
-
-
-def _allreduce_quantized_sync(
-    comm: Communicator, arrays: List[np.ndarray], row_size: int
-) -> List[np.ndarray]:
-    layout = [(a.shape, a.dtype, a.size) for a in arrays]
-    flat = np.concatenate(
-        [np.asarray(a, dtype=np.float32).reshape(-1) for a in arrays]
-    )
-
-    pipeline_err: Optional[BaseException] = None
-    try:
-        q_red, s_red, rows, rows_per_rank = _quantized_reduce_scatter_sync(
-            comm, flat, row_size, tag=101
-        )
-    except BaseException as e:  # noqa: BLE001
-        # Injected/future errors must not skip the remaining collective —
-        # peers would wedge in their allgather (FakeCommunicatorWrapper
-        # contract). Participate with a zero shard, then re-raise.
-        pipeline_err = e
-        q_red, s_red, rows, rows_per_rank = _zero_shard(
-            max(1, -(-flat.size // row_size)), row_size, comm.size()
-        )
-
-    summed = _allgather_reduced_shards(
-        comm, q_red, s_red, rows, rows_per_rank, row_size, flat.size, tag=102,
-        pipeline_err=pipeline_err,
-    )
-
-    out: List[np.ndarray] = []
-    off = 0
-    for shape, dtype, size in layout:
-        out.append(
-            summed[off : off + size].reshape(shape).astype(dtype, copy=False)
-        )
-        off += size
-    return out
 
 
 def _allgather_reduced_shards(
@@ -136,8 +193,9 @@ def _allgather_reduced_shards(
     n: int,
     tag: int,
     pipeline_err: Optional[BaseException],
+    kind: str = INT8,
 ) -> np.ndarray:
-    """Shared tail of both quantized allreduces: allgather the reduced
+    """Shared tail of the single-window allreduce: allgather the reduced
     shards and dequantize.  Always participates in the allgather — even
     after an upstream failure (``pipeline_err``), a zero shard is
     contributed so healthy peers are never wedged — then re-raises."""
@@ -145,25 +203,164 @@ def _allgather_reduced_shards(
     if pipeline_err is not None:
         raise pipeline_err
     qs_full, ss_full = zip(
-        *(_unpack(s, rows_per_rank, row_size) for s in all_shards)
+        *(_unpack(s, rows_per_rank, row_size, kind) for s in all_shards)
     )
     q_full = np.concatenate(qs_full)[:rows]
     s_full = np.concatenate(ss_full)[:rows]
-    return dequantize_int8_rowwise(q_full, s_full, n, np.float32)
+    return dequantize_rowwise(q_full, s_full, n, np.float32)
 
 
 def _zero_shard(
-    rows: int, row_size: int, ws: int
+    rows: int, row_size: int, ws: int, kind: str = INT8
 ) -> Tuple[np.ndarray, np.ndarray, int, int]:
     """Zero contribution with the shard geometry peers expect (``rows`` must
     equal the unpadded row count every rank derived from its own input)."""
     rows_per_rank = -(-rows // ws)
     return (
-        np.zeros((rows_per_rank, row_size), np.int8),
+        np.zeros((rows_per_rank, row_size), wire_dtype(kind)),
         np.zeros(rows_per_rank, np.float32),
         rows,
         rows_per_rank,
     )
+
+
+# ---------------------------------------------------------------------------
+# windowed pipelined allreduce
+# ---------------------------------------------------------------------------
+
+
+def _allreduce_pipelined_sync(
+    comm: Communicator,
+    q: np.ndarray,
+    scales: np.ndarray,
+    n: int,
+    tag_base: int,
+) -> np.ndarray:
+    """SUM-allreduce of quantized rows with window-level overlap.
+
+    Deterministic per-rank schedule (identical everywhere, so the single op
+    thread pairs frames correctly):
+
+        submit a2a(0)
+        for w: wait a2a(w); submit a2a(w+1); reduce(w); submit ag(w)
+        for w: wait ag(w); dequantize into the output
+
+    While the caller reduces window ``w``, the op thread drives ``a2a(w+1)``
+    then ``ag(w-1)`` over the sockets.  Any stage failure degrades that
+    window (and the rest of the schedule, if the communicator died) to zero
+    shards so peers never wedge, then the first error re-raises at the end —
+    same containment contract as the single-window path.
+    """
+    kind = _kind_of(q)
+    ws = comm.size()
+    rows, row_size = q.shape
+    win = _window_rows(row_size)
+    windows: List[Tuple[int, int]] = [
+        (start, min(start + win, rows)) for start in range(0, rows, win)
+    ]
+    W = len(windows)
+    err: Optional[BaseException] = None
+    out = np.empty(rows * row_size, dtype=np.float32)
+
+    def _submit_a2a(w: int) -> Work:
+        start, stop = windows[w]
+        wq, wsc = q[start:stop], scales[start:stop]
+        wrows = stop - start
+        rows_per_rank = -(-wrows // ws)
+        padded = rows_per_rank * ws
+        if padded != wrows:
+            wq = np.concatenate(
+                [wq, np.zeros((padded - wrows, row_size), q.dtype)]
+            )
+            wsc = np.concatenate(
+                [wsc, np.zeros(padded - wrows, np.float32)]
+            )
+        chunks = [
+            _pack(
+                wq[p * rows_per_rank : (p + 1) * rows_per_rank],
+                wsc[p * rows_per_rank : (p + 1) * rows_per_rank],
+            )
+            for p in range(ws)
+        ]
+        return comm.alltoall(chunks, tag=tag_base + 2 * w)
+
+    def _rows_per_rank(w: int) -> int:
+        start, stop = windows[w]
+        return -(-(stop - start) // ws)
+
+    a2a_work = _submit_a2a(0)
+    ag_works: List[Work] = []
+    for w in range(W):
+        rows_per_rank = _rows_per_rank(w)
+        try:
+            gathered = a2a_work.wait()
+        except BaseException as e:  # noqa: BLE001 — degrade, keep schedule
+            err = err or e
+            gathered = None
+        if w + 1 < W:
+            a2a_work = _submit_a2a(w + 1)
+        if gathered is not None:
+            try:
+                qs, scs = zip(
+                    *(
+                        _unpack(g, rows_per_rank, row_size, kind)
+                        for g in gathered
+                    )
+                )
+                q_red, s_red = _reduce_shards(np.stack(qs), np.stack(scs), kind)
+            except BaseException as e:  # noqa: BLE001
+                err = err or e
+                gathered = None
+        if gathered is None:
+            q_red = np.zeros((rows_per_rank, row_size), wire_dtype(kind))
+            s_red = np.zeros(rows_per_rank, np.float32)
+        ag_works.append(
+            comm.allgather(_pack(q_red, s_red), tag=tag_base + 2 * w + 1)
+        )
+
+    for w, work in enumerate(ag_works):
+        start, stop = windows[w]
+        rows_per_rank = _rows_per_rank(w)
+        try:
+            all_shards = work.wait()
+            qs_full, ss_full = zip(
+                *(
+                    _unpack(s, rows_per_rank, row_size, kind)
+                    for s in all_shards
+                )
+            )
+            q_full = np.concatenate(qs_full)[: stop - start]
+            s_full = np.concatenate(ss_full)[: stop - start]
+            out[start * row_size : stop * row_size] = dequantize_rowwise(
+                q_full, s_full, (stop - start) * row_size, np.float32
+            )
+        except BaseException as e:  # noqa: BLE001
+            err = err or e
+            out[start * row_size : stop * row_size] = 0.0
+
+    if err is not None:
+        raise err
+    return out[:n]
+
+
+def _allreduce_quantized_sync(
+    comm: Communicator, arrays: List[np.ndarray], row_size: int, kind: str = INT8
+) -> List[np.ndarray]:
+    layout = [(a.shape, a.dtype, a.size) for a in arrays]
+    flat = np.concatenate(
+        [np.asarray(a, dtype=np.float32).reshape(-1) for a in arrays]
+    )
+    q, scales = quantize_rowwise(flat, row_size, kind)
+    summed = _allreduce_pipelined_sync(comm, q, scales, flat.size, tag_base=110)
+
+    out: List[np.ndarray] = []
+    off = 0
+    for shape, dtype, size in layout:
+        out.append(
+            summed[off : off + size].reshape(shape).astype(dtype, copy=False)
+        )
+        off += size
+    return out
 
 
 def allreduce_prequantized(
@@ -172,56 +369,44 @@ def allreduce_prequantized(
     scales: np.ndarray,
     n: int,
 ) -> np.ndarray:
-    """SUM-allreduce of an already-quantized stream (int8 rows + f32 rowwise
-    scales, e.g. produced on device by ``ops.pallas_quant``); returns the
-    dequantized float32 sum of length ``n``.  Synchronous — callers layer
-    Work/threading on top (``Manager.allreduce_prequantized``)."""
+    """SUM-allreduce of an already-quantized stream (1-byte rows + f32
+    rowwise scales, e.g. produced on device by ``ops.pallas_quant``);
+    returns the dequantized float32 sum of length ``n``.  Synchronous —
+    callers layer Work/threading on top (``Manager.allreduce_prequantized``)."""
     scales = np.asarray(scales).reshape(-1)
     if comm.size() == 1 or getattr(comm, "is_passthrough", False):
-        return dequantize_int8_rowwise(q, scales, n, np.float32)
-    row_size = q.shape[1]
-    err: Optional[BaseException] = None
-    try:
-        q_red, s_red, rows, rows_per_rank = _prequantized_reduce_scatter_sync(
-            comm, q, scales, tag=105
-        )
-    except BaseException as e:  # noqa: BLE001 — still join the allgather
-        err = e
-        q_red, s_red, rows, rows_per_rank = _zero_shard(
-            q.shape[0], row_size, comm.size()
-        )
-    return _allgather_reduced_shards(
-        comm, q_red, s_red, rows, rows_per_rank, row_size, n, tag=106,
-        pipeline_err=err,
-    )
+        return dequantize_rowwise(q, scales, n, np.float32)
+    return _allreduce_pipelined_sync(comm, q, scales, n, tag_base=1050)
 
 
 def allreduce_quantized(
     comm: Communicator,
     buffers: Buffers,
     row_size: int = DEFAULT_ROW_SIZE,
+    kind: str = INT8,
 ) -> Work:
-    """SUM-allreduce through int8: the Work's value mirrors ``buffers`` with
-    summed float values (the Manager divides by participants afterwards,
-    exactly like the unquantized path).
+    """SUM-allreduce through a 1-byte wire format (int8 default, fp8
+    optional): the Work's value mirrors ``buffers`` with summed float values
+    (the Manager divides by participants afterwards, exactly like the
+    unquantized path).
 
     Accuracy: rowwise int8 carries ~2-3 decimal digits; intended for DiLoCo
     pseudogradients where the outer optimizer tolerates it (the reference
-    ships fp8 with the same caveat).
+    ships fp8 with the same caveat — pass ``kind="fp8"`` for that format).
     """
     single = isinstance(buffers, np.ndarray)
     arrays: List[np.ndarray] = [buffers] if single else list(buffers)
 
     if comm.size() == 1 or getattr(comm, "is_passthrough", False):
         # single member (or a passthrough test double): the sum is our own
-        # contribution; round-trip through int8 so quantization error stays
-        # observable in tests
+        # contribution; round-trip through the wire format so quantization
+        # error stays observable in tests
         out = []
         for a in arrays:
             flat = np.asarray(a, dtype=np.float32).reshape(-1)
-            q, s = quantize_int8_rowwise(flat, row_size)
+            q, s = quantize_rowwise(flat, row_size, kind)
             out.append(
-                dequantize_int8_rowwise(q, s, flat.size, np.float32)
+                dequantize_rowwise(q, s, flat.size, np.float32)
                 .reshape(a.shape)
                 .astype(a.dtype, copy=False)
             )
@@ -231,7 +416,7 @@ def allreduce_quantized(
 
     def _run() -> None:
         try:
-            out = _allreduce_quantized_sync(comm, arrays, row_size)
+            out = _allreduce_quantized_sync(comm, arrays, row_size, kind)
             fut.set_result(out[0] if single else out)
         except BaseException as e:  # noqa: BLE001
             fut.set_exception(e)
@@ -246,6 +431,7 @@ def reduce_scatter_quantized(
     comm: Communicator,
     buffers: Buffers,
     row_size: int = DEFAULT_ROW_SIZE,
+    kind: str = INT8,
 ) -> Work:
     """Quantized reduce-scatter (``collectives.py:159-294``): each rank gets
     the dequantized sum of its row-shard only (flat float32)."""
@@ -255,15 +441,15 @@ def reduce_scatter_quantized(
         [np.asarray(a, dtype=np.float32).reshape(-1) for a in arrays]
     )
     if comm.size() == 1 or getattr(comm, "is_passthrough", False):
-        q, s = quantize_int8_rowwise(flat, row_size)
-        return DummyWork(dequantize_int8_rowwise(q, s, flat.size, np.float32))
+        q, s = quantize_rowwise(flat, row_size, kind)
+        return DummyWork(dequantize_rowwise(q, s, flat.size, np.float32))
 
     fut: Future = Future()
 
     def _run() -> None:
         try:
             q_red, s_red, _rows, rows_per_rank = _quantized_reduce_scatter_sync(
-                comm, flat, row_size, tag=103
+                comm, flat, row_size, tag=103, kind=kind
             )
             total = (q_red.astype(np.float32) * s_red[:, None]).reshape(-1)
             fut.set_result(total)

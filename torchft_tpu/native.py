@@ -195,12 +195,119 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tpuft_comm_barrier.argtypes = [ctypes.c_void_p]
         lib.tpuft_comm_abort.argtypes = [ctypes.c_void_p]
         lib.tpuft_comm_free.argtypes = [ctypes.c_void_p]
+        lib.tpuft_quantize_rowwise.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.tpuft_dequantize_rowwise.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        lib.tpuft_reduce_rowwise.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# host quantization kernels (native/quant.h) — one-pass, multithreaded,
+# -march=native; the numpy fallbacks in quantization.py make several full
+# passes with temporaries and dominate the DCN quantized pipeline
+# ---------------------------------------------------------------------------
+
+
+def _check(lib: ctypes.CDLL, rc: int) -> None:
+    if rc != 0:
+        raise RuntimeError(lib.tpuft_last_error().decode())
+
+
+def quantize_rowwise_native(
+    flat: np.ndarray, row_size: int
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    lib = _load()
+    if lib is None:
+        return None
+    flat = np.ascontiguousarray(flat, dtype=np.float32)
+    n = flat.size
+    rows = max(1, -(-n // row_size))
+    q = np.empty((rows, row_size), np.int8)
+    scales = np.empty(rows, np.float32)
+    if n == 0:
+        q[:] = 0
+        scales[:] = 0.0
+        return q, scales
+    _check(
+        lib,
+        lib.tpuft_quantize_rowwise(
+            _data_ptr(flat), n, row_size, _data_ptr(q), _data_ptr(scales)
+        ),
+    )
+    return q, scales
+
+
+def dequantize_rowwise_native(
+    q: np.ndarray, scales: np.ndarray, n: int
+) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    q = np.ascontiguousarray(q, dtype=np.int8)
+    scales = np.ascontiguousarray(scales, dtype=np.float32)
+    out = np.empty(n, np.float32)
+    if n == 0:
+        return out
+    _check(
+        lib,
+        lib.tpuft_dequantize_rowwise(
+            _data_ptr(q), _data_ptr(scales), n, q.shape[1], _data_ptr(out)
+        ),
+    )
+    return out
+
+
+def reduce_rowwise_native(
+    qs: np.ndarray, scales: np.ndarray
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """qs int8 [w, rows, row_size], scales f32 [w, rows] → requantized
+    (q [rows, row_size], scales [rows]) of the float32 sum."""
+    lib = _load()
+    if lib is None:
+        return None
+    qs = np.ascontiguousarray(qs, dtype=np.int8)
+    scales = np.ascontiguousarray(scales, dtype=np.float32)
+    w, rows, row_size = qs.shape
+    q_out = np.empty((rows, row_size), np.int8)
+    s_out = np.empty(rows, np.float32)
+    _check(
+        lib,
+        lib.tpuft_reduce_rowwise(
+            _data_ptr(qs),
+            _data_ptr(scales),
+            w,
+            rows,
+            row_size,
+            _data_ptr(q_out),
+            _data_ptr(s_out),
+        ),
+    )
+    return q_out, s_out
 
 
 def _data_ptr(arr: np.ndarray) -> ctypes.c_void_p:

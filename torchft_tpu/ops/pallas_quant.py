@@ -101,6 +101,70 @@ def quantize_int8_rowwise_device(
     )(x)
 
 
+def _reduce_kernel(qs_ref, s_ref, q_ref, out_s_ref):
+    # dequant-sum-requant in one VMEM-resident pass (the reference's
+    # fused_reduce_fp8, torchft/quantization.py:638): qs [w, B, R] int8,
+    # scales [w, B, 1] f32 -> requantized (q [B, R], scales [B, 1])
+    total = jnp.sum(
+        qs_ref[:].astype(jnp.float32) * s_ref[:], axis=0
+    )
+    q, scale = _quant_math(total)
+    q_ref[:] = q
+    out_s_ref[:] = scale
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def reduce_quantized_device(
+    qs: jax.Array, scales: jax.Array, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused dequant-sum-requant of ``w`` quantized contributions ON DEVICE:
+    qs int8 [w, rows, row_size], scales f32 [w, rows, 1] → (int8 [rows,
+    row_size], f32 [rows, 1]) of the float32 sum.
+
+    The host ships w int8 shards in, gets one int8 shard back — float32
+    never crosses the PCIe/HBM boundary, which is the point of the
+    reference's in-kernel reduce.  Off-TPU the same math runs as jnp.
+    """
+    w, rows, row_size = qs.shape
+    if scales.ndim == 2:
+        scales = scales[:, :, None]
+    if not (interpret or _on_tpu()):
+        total = jnp.sum(qs.astype(jnp.float32) * scales, axis=0)
+        return _quant_math(total)
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    # rows were padded to BLOCK_ROWS by the quantizer; guard anyway
+    assert rows % BLOCK_ROWS == 0, rows
+    grid = (rows // BLOCK_ROWS,)
+    return pl.pallas_call(
+        _reduce_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (w, BLOCK_ROWS, row_size),
+                lambda i: (0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (w, BLOCK_ROWS, 1), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (BLOCK_ROWS, row_size), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((BLOCK_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, row_size), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qs, scales)
+
+
 @functools.partial(jax.jit, static_argnames=("n", "interpret"))
 def dequantize_int8_rowwise_device(
     q: jax.Array, scales: jax.Array, n: int, interpret: bool = False

@@ -1,15 +1,18 @@
-"""Rowwise int8 quantization for bandwidth-reduced collectives.
+"""Rowwise quantization (int8 / fp8) for bandwidth-reduced collectives.
 
 The reference fuses fp8 quantize/dequantize/reduce into triton kernels
 (``torchft/quantization.py:44-686``, CUDA-only).  torchft_tpu's replica-dim
 collectives run host-side over DCN, so the wire format lives here as
-vectorized numpy; the device-side (Pallas) quantize kernel that reduces
-HBM→host transfer bytes lives in ``torchft_tpu/ops/``.
+vectorized numpy; the device-side (Pallas) quantize/reduce kernels that cut
+HBM→host transfer bytes live in ``torchft_tpu/ops/``.
 
 Wire format per buffer: the flat array is viewed as rows of ``row_size``
-elements (last row padded); each row is scaled by ``max(|row|)/127`` into
-int8.  Scales travel as float32 alongside the payload, mirroring the
-reference's interleaved rowwise-scale layout.
+elements (last row padded); each row is scaled by ``max(|row|)/Q`` into the
+wire dtype — int8 (Q=127) or float8_e4m3 (Q=448, the reference's format,
+via ml_dtypes).  Scales travel as float32 alongside the payload, mirroring
+the reference's interleaved rowwise-scale layout.  Both formats are one
+byte/element; fp8 trades the int8 grid's uniform spacing for more dynamic
+range within a row.
 """
 
 from __future__ import annotations
@@ -20,45 +23,136 @@ import numpy as np
 
 DEFAULT_ROW_SIZE = 1024
 
+# wire dtypes: name -> (numpy dtype, max representable magnitude)
+try:  # ml_dtypes ships with jax
+    import ml_dtypes
 
-def quantize_int8_rowwise(
-    flat: np.ndarray, row_size: int = DEFAULT_ROW_SIZE
+    _FP8 = np.dtype(ml_dtypes.float8_e4m3fn)
+    FP8_MAX = 448.0
+except ImportError:  # pragma: no cover - ml_dtypes is a jax dependency
+    _FP8 = None
+    FP8_MAX = 448.0
+
+INT8 = "int8"
+FP8 = "fp8"
+
+
+def wire_dtype(kind: str) -> np.dtype:
+    if kind == INT8:
+        return np.dtype(np.int8)
+    if kind == FP8:
+        if _FP8 is None:
+            raise RuntimeError("fp8 wire format requires ml_dtypes")
+        return _FP8
+    raise ValueError(f"unknown wire dtype {kind!r}")
+
+
+def _wire_max(kind: str) -> float:
+    return 127.0 if kind == INT8 else FP8_MAX
+
+
+def _native_kernels():
+    """The C++ host kernels (native/quant.h) when the native runtime built;
+    resolved lazily and cached (None entries mean 'fall back to numpy')."""
+    global _NATIVE
+    if _NATIVE is _UNRESOLVED:
+        try:
+            from torchft_tpu import native
+
+            if native.available():
+                _NATIVE = native
+            else:
+                _NATIVE = None
+        except Exception:  # pragma: no cover - import/build failure
+            _NATIVE = None
+    return _NATIVE
+
+
+_UNRESOLVED = object()
+_NATIVE = _UNRESOLVED
+
+
+def quantize_rowwise(
+    flat: np.ndarray, row_size: int = DEFAULT_ROW_SIZE, kind: str = INT8
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Quantize a flat float array → (int8 payload [rows, row_size],
+    """Quantize a flat float array → (1-byte payload [rows, row_size],
     float32 scales [rows]). The payload is padded to a whole row."""
     assert flat.ndim == 1
+    if kind == INT8:
+        native = _native_kernels()
+        if native is not None:
+            out = native.quantize_rowwise_native(flat, row_size)
+            if out is not None:
+                return out
     n = flat.size
     rows = max(1, -(-n // row_size))
     padded = np.zeros(rows * row_size, dtype=np.float32)
     padded[:n] = flat.astype(np.float32, copy=False)
     padded = padded.reshape(rows, row_size)
+    qmax = _wire_max(kind)
     absmax = np.abs(padded).max(axis=1)
-    scales = (absmax / 127.0).astype(np.float32)
+    scales = (absmax / qmax).astype(np.float32)
     safe = np.where(scales > 0, scales, 1.0)
-    q = np.clip(np.rint(padded / safe[:, None]), -127, 127).astype(np.int8)
+    scaled = padded / safe[:, None]
+    if kind == INT8:
+        q = np.clip(np.rint(scaled), -127, 127).astype(np.int8)
+    else:
+        q = np.clip(scaled, -qmax, qmax).astype(wire_dtype(kind))
     return q, scales
 
 
-def dequantize_int8_rowwise(
+def dequantize_rowwise(
     q: np.ndarray, scales: np.ndarray, n: int, dtype: np.dtype
 ) -> np.ndarray:
-    """Inverse of :func:`quantize_int8_rowwise`, truncated to ``n``."""
+    """Inverse of :func:`quantize_rowwise`, truncated to ``n`` (dtype of
+    ``q`` distinguishes the wire format)."""
+    if q.dtype == np.int8 and dtype == np.float32:
+        native = _native_kernels()
+        if native is not None:
+            out = native.dequantize_rowwise_native(q, scales, n)
+            if out is not None:
+                return out
     out = (q.astype(np.float32) * scales[:, None]).reshape(-1)[:n]
     return out.astype(dtype, copy=False)
 
 
 def reduce_quantized(
-    qs: np.ndarray, scales: np.ndarray
+    qs: np.ndarray, scales: np.ndarray, kind: str = INT8
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Sum ``w`` quantized copies: qs [w, rows, row_size], scales [w, rows]
     → requantized (q [rows, row_size], scales [rows]) of the float sum.
 
     The accumulate happens in float32 (the analog of the reference's
-    ``fused_reduce_fp8`` dequant-sum-requant, ``quantization.py:638``).
+    ``fused_reduce_fp8`` dequant-sum-requant, ``quantization.py:638``); the
+    device-resident twin is ``ops.pallas_quant.reduce_quantized_device``.
     """
+    if kind == INT8 and qs.dtype == np.int8:
+        native = _native_kernels()
+        if native is not None:
+            out = native.reduce_rowwise_native(qs, scales)
+            if out is not None:
+                return out
     total = (qs.astype(np.float32) * scales[:, :, None]).sum(axis=0)
+    qmax = _wire_max(kind)
     absmax = np.abs(total).max(axis=1)
-    out_scales = (absmax / 127.0).astype(np.float32)
+    out_scales = (absmax / qmax).astype(np.float32)
     safe = np.where(out_scales > 0, out_scales, 1.0)
-    q = np.clip(np.rint(total / safe[:, None]), -127, 127).astype(np.int8)
+    scaled = total / safe[:, None]
+    if kind == INT8:
+        q = np.clip(np.rint(scaled), -127, 127).astype(np.int8)
+    else:
+        q = np.clip(scaled, -qmax, qmax).astype(wire_dtype(kind))
     return q, out_scales
+
+
+# backwards-compatible int8-named surface (round-1 API)
+def quantize_int8_rowwise(
+    flat: np.ndarray, row_size: int = DEFAULT_ROW_SIZE
+) -> Tuple[np.ndarray, np.ndarray]:
+    return quantize_rowwise(flat, row_size, INT8)
+
+
+def dequantize_int8_rowwise(
+    q: np.ndarray, scales: np.ndarray, n: int, dtype: np.dtype
+) -> np.ndarray:
+    return dequantize_rowwise(q, scales, n, dtype)
