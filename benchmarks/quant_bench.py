@@ -58,7 +58,7 @@ def _rank_main(rank: int, world: int, port: int, mb: int, iters: int, window_mb:
         out_q.put({"window_mb": window_mb, "sec": dt, "gbps": buf.nbytes / dt / 1e9})
 
 
-def run(mb: int, iters: int, window_mb: str, port: int) -> dict:
+def run(mb: int, iters: int, window_mb: str) -> dict:
     from torchft_tpu.store import StoreServer
 
     store = StoreServer("127.0.0.1:0")
@@ -86,8 +86,8 @@ def main() -> None:
     parser.add_argument("--iters", type=int, default=3)
     args = parser.parse_args()
 
-    single = run(args.mb, args.iters, "100000", port=0)  # one giant window
-    piped = run(args.mb, args.iters, "4", port=0)
+    single = run(args.mb, args.iters, "100000")  # one giant window
+    piped = run(args.mb, args.iters, "4")
     print(
         json.dumps(
             {
