@@ -276,3 +276,92 @@ def test_sharded_host_array_restore_like() -> None:
     restored = restore_like(new, old)
     assert restored.sharding == sh
     np.testing.assert_array_equal(np.asarray(restored), want)
+
+
+class TestStreamingPlan:
+    def _tree(self):
+        rng = np.random.default_rng(5)
+        return {
+            "w": rng.normal(size=(37, 11)).astype(np.float32),
+            "b": rng.normal(size=129).astype(np.float64),
+            "step": 7,
+            "nested": [rng.integers(0, 100, size=13).astype(np.int32)],
+        }
+
+    def test_write_range_reassembles(self) -> None:
+        from torchft_tpu.checkpointing.serialization import (
+            dumps_pytree,
+            plan_pytree,
+        )
+
+        tree = self._tree()
+        blob = dumps_pytree(tree)
+        plan = plan_pytree(tree)
+        assert plan.total_len == len(blob)
+        # any chunking of the byte range must reassemble to the full blob
+        for n in (1, 2, 3, 7):
+            size = -(-plan.total_len // n)
+            buf = io.BytesIO()
+            for i in range(n):
+                plan.write_range(
+                    i * size, min(plan.total_len, (i + 1) * size), buf
+                )
+            assert buf.getvalue() == blob
+
+    def test_copy_mutable_snapshots_numpy(self) -> None:
+        from torchft_tpu.checkpointing.serialization import (
+            loads_pytree,
+            plan_pytree,
+        )
+
+        tree = self._tree()
+        plan = plan_pytree(tree, snapshot=True)
+        expected = tree["w"].copy()
+        tree["w"][:] = -1.0  # train loop mutates after staging
+        buf = io.BytesIO()
+        plan.write_range(0, plan.total_len, buf)
+        out = loads_pytree(buf.getvalue())
+        np.testing.assert_array_equal(out["w"], expected)
+
+    def test_leaf_hook_maps_on_arrival(self) -> None:
+        from torchft_tpu.checkpointing.serialization import (
+            dumps_pytree,
+            load_pytree,
+        )
+
+        tree = self._tree()
+        seen = []
+
+        def hook(arr):
+            seen.append(arr.shape)
+            return arr * 0 + 1 if arr.dtype.kind == "f" else arr
+
+        out = load_pytree(io.BytesIO(dumps_pytree(tree)), leaf_hook=hook)
+        assert len(seen) == 3
+        np.testing.assert_array_equal(out["w"], np.ones_like(tree["w"]))
+        np.testing.assert_array_equal(out["nested"][0], tree["nested"][0])
+
+    def test_jax_leaves_stage_on_device(self) -> None:
+        """jax leaves must not be materialized to HOST at plan time (the
+        staging copy the streaming rework removes); the snapshot is a
+        device-side copy, immune to later donation of the original."""
+        import jax
+
+        from torchft_tpu.checkpointing.serialization import plan_pytree
+
+        cpu = jax.local_devices(backend="cpu")[0]
+        leaf = jax.device_put(np.arange(1000, dtype=np.float32), cpu)
+        plan = plan_pytree({"p": leaf}, snapshot=True)
+        staged = plan.leaves[0]
+        assert isinstance(staged, jax.Array) and staged is not leaf
+        # survives deletion of the original (what donation does)
+        leaf.delete()
+        import io as iomod
+
+        buf = iomod.BytesIO()
+        plan.write_range(0, plan.total_len, buf)
+        from torchft_tpu.checkpointing.serialization import loads_pytree
+
+        np.testing.assert_array_equal(
+            loads_pytree(buf.getvalue())["p"], np.arange(1000, dtype=np.float32)
+        )

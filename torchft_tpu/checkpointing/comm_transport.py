@@ -27,9 +27,11 @@ import numpy as np
 
 from torchft_tpu.checkpointing.serialization import (
     _extract_arrays,
+    _leaf_meta,
     _restore_arrays,
     _resolve_dtype,
     as_byte_view,
+    materialize_leaf,
 )
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.communicator import Communicator
@@ -67,30 +69,41 @@ class CommTransport(CheckpointTransport[T]):
         # can never alias a newer one.
         return _TAG_BASE * 1000 + step * 10_000_000
 
+    # submission window: at most this many leaves' host copies are alive at
+    # once while streaming a heal (the sends pipeline; the window caps RSS)
+    _SEND_WINDOW_LEAVES = 4
+
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: T, timeout: float
     ) -> None:
-        arrays: List[np.ndarray] = []
+        import time as _time
+
+        arrays: List[object] = []
         skeleton = _extract_arrays(state_dict, arrays)
         meta = pickle.dumps(
-            (
-                skeleton,
-                [(a.dtype.name, a.shape) for a in arrays],
-            ),
+            (skeleton, [_leaf_meta(a) for a in arrays]),
             protocol=pickle.HIGHEST_PROTOCOL,
         )
         base = self._tags(step)
-        # zero-copy: send straight from each array's buffer (one byte view
-        # per array, shared across destinations); submit every send before
-        # waiting, so multi-dest heals overlap
-        blobs = [as_byte_view(arr) for arr in arrays]
-        works = []
+        deadline = _time.monotonic() + timeout
+        # leaves materialize to host lazily, one at a time, and are sent
+        # zero-copy from their buffer; a bounded window of in-flight sends
+        # overlaps D2H of leaf k+1 with the wire of leaf k while capping
+        # peak extra host RSS at ~_SEND_WINDOW_LEAVES leaves
+        works: List[tuple] = []
         for dst in dst_ranks:
-            works.append(self._comm.send_bytes(meta, dst, tag=base))
-            for i, blob in enumerate(blobs):
-                works.append(self._comm.send_bytes(blob, dst, tag=base + 1 + i))
-        for work in works:
-            work.wait(timeout=timeout)
+            works.append((self._comm.send_bytes(meta, dst, tag=base), meta))
+        for i, leaf in enumerate(arrays):
+            blob = as_byte_view(materialize_leaf(leaf))
+            for dst in dst_ranks:
+                works.append(
+                    (self._comm.send_bytes(blob, dst, tag=base + 1 + i), blob)
+                )
+            while len(works) > self._SEND_WINDOW_LEAVES * len(dst_ranks):
+                work, _keepalive = works.pop(0)
+                work.wait(timeout=max(0.0, deadline - _time.monotonic()))
+        for work, _keepalive in works:
+            work.wait(timeout=max(0.0, deadline - _time.monotonic()))
         logger.info(
             "sent checkpoint step=%d (%d arrays) to ranks %s",
             step,

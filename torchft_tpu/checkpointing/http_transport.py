@@ -7,15 +7,19 @@ in parallel); the RWLock freezes the state dict while it is being serialized
 so the train loop can't mutate weights mid-transfer
 (``http_transport.py:181-202``).
 
-Divergence from the reference: the staged state is serialized once into
-chunk buffers at ``send_checkpoint`` time (jax arrays must be device_get
-anyway, so "staging to CPU" and "serializing" collapse into one step);
-serving threads then just stream bytes, holding no lock against training.
+Divergence from the reference: staging stores a serialization *plan* (the
+tree skeleton + references to the immutable jax leaves; mutable numpy
+leaves are snapshotted), and serving threads materialize one leaf at a time
+while streaming it to the socket (the reference's incremental-save analog,
+``_serialization.py:14-39``).  Peak extra host RSS during a heal send is
+one leaf, not 1-2× the model; chunked fetches stream the byte range they
+own the same way.  jax leaves are snapshotted on device at staging time so
+a donating jit (e.g. HSDPTrainer's update) can't invalidate them while a
+peer is still fetching.
 """
 
 from __future__ import annotations
 
-import io
 import logging
 import socket
 import threading
@@ -26,15 +30,46 @@ from urllib.request import urlopen
 
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.checkpointing.serialization import (
-    dumps_pytree,
+    PytreePlan,
     load_pytree,
-    loads_pytree,
+    plan_pytree,
 )
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
 logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
+
+
+def _read_stream_into(resp, view: memoryview) -> None:
+    """Drain exactly ``len(view)`` bytes from a response into ``view``."""
+    off = 0
+    while off < len(view):
+        n = resp.readinto(view[off:])
+        if not n:
+            raise EOFError("truncated checkpoint response")
+        off += n
+
+
+class _ViewReader:
+    """Minimal read/readinto stream over a memoryview (no BytesIO copy)."""
+
+    def __init__(self, view: memoryview) -> None:
+        self._view = view
+        self._off = 0
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = len(self._view) - self._off
+        out = bytes(self._view[self._off : self._off + n])
+        self._off += len(out)
+        return out
+
+    def readinto(self, out) -> int:
+        n = min(len(out), len(self._view) - self._off)
+        out[:n] = self._view[self._off : self._off + n]
+        self._off += n
+        return n
 
 
 class HTTPTransport(CheckpointTransport[T]):
@@ -73,39 +108,70 @@ class HTTPTransport(CheckpointTransport[T]):
                 if not transport._allowed.wait(timeout=transport._timeout):
                     self.send_error(503, "no checkpoint staged")
                     return
+                # the lock is only held to grab the plan reference — the
+                # plan's leaves are self-contained snapshots, so streaming
+                # happens lock-free and a concurrent disallow_checkpoint
+                # (write lock, taken in the commit path) never waits on a
+                # slow healer's socket
                 with transport._lock.r_lock():
                     staged = transport._staged
-                    if staged is None:
-                        self.send_error(503, "no checkpoint staged")
+                    plan: Optional[PytreePlan] = (
+                        staged["plan"] if staged is not None else None  # type: ignore[assignment,index]
+                    )
+                    staged_step = staged["step"] if staged is not None else None
+                if plan is None:
+                    self.send_error(503, "no checkpoint staged")
+                    return
+                step = int(parts[1])
+                if staged_step != step:
+                    self.send_error(
+                        404,
+                        f"staged step {staged_step} != requested {step}",
+                    )
+                    return
+                num_chunks = max(1, transport._num_chunks)
+                chunk_size = -(-plan.total_len // num_chunks)
+                if parts[2] == "full":
+                    start, stop = 0, plan.total_len
+                else:
+                    idx = int(parts[2])
+                    if idx >= num_chunks:
+                        self.send_error(404, f"no chunk {idx}")
                         return
-                    step = int(parts[1])
-                    if staged["step"] != step:
-                        self.send_error(
-                            404,
-                            f"staged step {staged['step']} != requested {step}",
-                        )
-                        return
-                    chunks: List[bytes] = staged["chunks"]  # type: ignore[assignment]
-                    if parts[2] == "full":
-                        payload = b"".join(chunks)
-                    else:
-                        idx = int(parts[2])
-                        if idx >= len(chunks):
-                            self.send_error(404, f"no chunk {idx}")
-                            return
-                        payload = chunks[idx]
+                    start = idx * chunk_size
+                    stop = min(plan.total_len, start + chunk_size)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")
-                self.send_header("Content-Length", str(len(payload)))
-                self.send_header("X-Num-Chunks", str(len(chunks)))
+                self.send_header("Content-Length", str(stop - start))
+                self.send_header("X-Num-Chunks", str(num_chunks))
+                self.send_header("X-Total-Len", str(plan.total_len))
                 self.end_headers()
-                self.wfile.write(payload)
+                # streams leaf by leaf: only leaves overlapping [start, stop)
+                # are ever materialized on host
+                plan.write_range(start, stop, self.wfile)
 
         class _Server(ThreadingHTTPServer):
             daemon_threads = True
             allow_reuse_address = True
 
-        self._server = _Server(("0.0.0.0", 0), _Handler)
+        # dual-stack like the reference's checkpoint server
+        # (torchft/http.py:11-13): bind [::] with v6only off where the
+        # kernel allows, so v4 and v6 healers both reach us
+        v6_server = None
+        try:
+            _Server.address_family = socket.AF_INET6
+            v6_server = _Server(("::", 0), _Handler, bind_and_activate=False)
+            v6_server.socket.setsockopt(
+                socket.IPPROTO_IPV6, socket.IPV6_V6ONLY, 0
+            )
+            v6_server.server_bind()
+            v6_server.server_activate()
+            self._server = v6_server
+        except OSError:
+            if v6_server is not None:
+                v6_server.server_close()
+            _Server.address_family = socket.AF_INET
+            self._server = _Server(("0.0.0.0", 0), _Handler)
         self._port: int = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever,
@@ -124,16 +190,12 @@ class HTTPTransport(CheckpointTransport[T]):
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: T, timeout: float
     ) -> None:
-        """Serialize once under the write lock, then serve lock-free."""
-        blob = dumps_pytree(state_dict)
-        if self._num_chunks > 0:
-            n = self._num_chunks
-            size = max(1, (len(blob) + n - 1) // n)
-            chunks = [blob[i : i + size] for i in range(0, len(blob), size)] or [b""]
-        else:
-            chunks = [blob]
+        """Stage a streaming plan under the write lock; serving threads
+        materialize leaves lazily (bytes are generated per-request, never
+        staged)."""
+        plan = plan_pytree(state_dict, snapshot=True)
         with self._lock.w_lock(timeout=timeout):
-            self._staged = {"step": step, "chunks": chunks}
+            self._staged = {"step": step, "plan": plan}
         self._allowed.set()
 
     def disallow_checkpoint(self) -> None:
@@ -142,23 +204,45 @@ class HTTPTransport(CheckpointTransport[T]):
             self._staged = None
 
     def recv_checkpoint(
-        self, src_rank: int, metadata: str, step: int, timeout: float
+        self,
+        src_rank: int,
+        metadata: str,
+        step: int,
+        timeout: float,
+        leaf_hook=None,
     ) -> T:
-        base = f"{metadata}/checkpoint/{step}"
-        with urlopen(f"{base}/full" if self._num_chunks == 0 else f"{base}/0", timeout=timeout) as resp:
-            if self._num_chunks == 0:
-                return load_pytree(resp)  # type: ignore[return-value]
-            first = resp.read()
-            total = int(resp.headers.get("X-Num-Chunks", "1"))
+        """Fetch and deserialize a peer's live checkpoint.
 
-        chunks: List[Optional[bytes]] = [None] * total
-        chunks[0] = first
+        Default (num_chunks=0) is fully streaming: array payloads are read
+        straight off the socket into preallocated arrays, and ``leaf_hook``
+        (e.g. a ``jax.device_put`` with the healing replica's sharding) maps
+        each leaf on arrival so its host copy dies immediately."""
+        base = f"{metadata}/checkpoint/{step}"
+        if self._num_chunks == 0:
+            with urlopen(f"{base}/full", timeout=timeout) as resp:
+                return load_pytree(resp, leaf_hook=leaf_hook)  # type: ignore[return-value]
+
+        # chunked mode: parallel range fetches landing in one preallocated
+        # buffer (no per-chunk bytes objects, no join copy)
+        with urlopen(f"{base}/0", timeout=timeout) as resp:
+            total = int(resp.headers.get("X-Num-Chunks", "1"))
+            total_len = int(resp.headers["X-Total-Len"])
+            chunk_size = -(-total_len // max(1, total))
+            buf = bytearray(total_len)
+            view = memoryview(buf)
+            _read_stream_into(resp, view[: min(chunk_size, total_len)])
+
+        done = [False] * total
+        done[0] = True
         errors: List[BaseException] = []
 
         def _fetch(i: int) -> None:
             try:
+                start = i * chunk_size
+                stop = min(total_len, start + chunk_size)
                 with urlopen(f"{base}/{i}", timeout=timeout) as r:
-                    chunks[i] = r.read()
+                    _read_stream_into(r, view[start:stop])
+                done[i] = True
             except BaseException as e:  # noqa: BLE001 — re-raised on the caller
                 errors.append(e)
 
@@ -174,9 +258,9 @@ class HTTPTransport(CheckpointTransport[T]):
             # a real fetch failure (404/refused) must not masquerade as a
             # timeout
             raise errors[0]
-        if any(c is None for c in chunks):
+        if not all(done):
             raise TimeoutError("chunked checkpoint fetch timed out")
-        return loads_pytree(b"".join(chunks))  # type: ignore[arg-type]
+        return load_pytree(_ViewReader(view), leaf_hook=leaf_hook)  # type: ignore[return-value]
 
     def shutdown(self, wait: bool = True) -> None:
         self._server.shutdown()

@@ -107,9 +107,31 @@ def _is_multihost_jax_array(x: Any) -> bool:
     )
 
 
-def _extract_arrays(obj: Any, arrays: List[np.ndarray]) -> Any:
+def materialize_leaf(leaf: Any) -> np.ndarray:
+    """Host numpy view/copy of a collected leaf (jax arrays device_get
+    here, NOT at extraction time — the point of the lazy plan is that only
+    one leaf's host copy is ever live during a streaming send)."""
+    if isinstance(leaf, np.ndarray):
+        return leaf
+    if hasattr(leaf, "data") and not hasattr(leaf, "__array__"):
+        # jax Shard
+        return np.asarray(leaf.data)
+    return np.asarray(leaf)
+
+
+def _leaf_meta(leaf: Any) -> Tuple[str, Tuple[int, ...]]:
+    """(dtype name, shape) without materializing the leaf on host."""
+    if hasattr(leaf, "data") and not hasattr(leaf, "__array__"):
+        leaf = leaf.data
+    return np.dtype(leaf.dtype).name, tuple(leaf.shape)
+
+
+def _extract_arrays(obj: Any, arrays: List[Any]) -> Any:
     """Deep-copy the container skeleton, swapping array leaves for
-    placeholders (handles dict/list/tuple; other types pickle as-is)."""
+    placeholders (handles dict/list/tuple; other types pickle as-is).
+
+    ``arrays`` collects the RAW leaves (numpy arrays, jax Arrays, jax
+    Shards) — call :func:`materialize_leaf` to get host bytes for one."""
     if _is_multihost_jax_array(obj):
         # ship only this host's unique addressable shards; the receiving
         # twin host reassembles them into its identical sharding layout
@@ -119,21 +141,21 @@ def _extract_arrays(obj: Any, arrays: List[np.ndarray]) -> Any:
             unique.setdefault(shard_key(s.index, shape), s)
         entries: List[Tuple[Tuple, _ArrayPlaceholder]] = []
         for k in sorted(unique):
-            arr = np.asarray(unique[k].data)
+            dtype_name, sshape = _leaf_meta(unique[k])
             entries.append(
-                (k, _ArrayPlaceholder(index=len(arrays), dtype=arr.dtype.name, shape=arr.shape))
+                (k, _ArrayPlaceholder(index=len(arrays), dtype=dtype_name, shape=sshape))
             )
-            arrays.append(arr)
+            arrays.append(unique[k])
         return _ShardedArrayPlaceholder(
             shape=shape, dtype=obj.dtype.name, entries=entries
         )
     if _is_array_leaf(obj):
-        arr = np.asarray(obj)
         # dtype.name (not .str) so extension dtypes like bfloat16 round-trip
+        dtype_name, shape = _leaf_meta(obj)
         placeholder = _ArrayPlaceholder(
-            index=len(arrays), dtype=arr.dtype.name, shape=arr.shape
+            index=len(arrays), dtype=dtype_name, shape=shape
         )
-        arrays.append(arr)
+        arrays.append(obj)
         return placeholder
     if isinstance(obj, dict):
         return {k: _extract_arrays(v, arrays) for k, v in obj.items()}
@@ -169,17 +191,102 @@ def _restore_arrays(obj: Any, arrays: List[np.ndarray]) -> Any:
     return obj
 
 
-def save_pytree(state: Any, stream: BinaryIO) -> None:
-    arrays: List[np.ndarray] = []
-    skeleton = _extract_arrays(state, arrays)
-    payload = pickle.dumps(skeleton, protocol=pickle.HIGHEST_PROTOCOL)
+@dataclass
+class PytreePlan:
+    """Serialization plan: everything needed to stream a pytree without
+    materializing more than one leaf on host at a time.
 
-    stream.write(MAGIC)
-    stream.write(struct.pack("<I", len(payload)))
-    stream.write(payload)
-    stream.write(struct.pack("<I", len(arrays)))
-    for arr in arrays:
-        stream.write(struct.pack("<Q", arr.nbytes))
+    ``header`` is the byte prefix (magic + skeleton + array count); each
+    leaf then rides as an 8-byte length + raw bytes.  ``total_len`` lets a
+    server send Content-Length before generating a byte of payload."""
+
+    header: bytes
+    leaves: List[Any]
+    leaf_nbytes: List[int]
+    total_len: int
+
+    def write_range(self, start: int, stop: int, stream: BinaryIO) -> None:
+        """Stream bytes [start, stop) of the serialized form, materializing
+        only the leaves that overlap the range (chunked HTTP fetches)."""
+        off = 0
+
+        def _emit(chunk) -> None:
+            nonlocal off
+            n = len(chunk)
+            lo, hi = max(start, off), min(stop, off + n)
+            if lo < hi:
+                stream.write(memoryview(chunk)[lo - off : hi - off])
+            off += n
+
+        _emit(self.header)
+        for leaf, nbytes in zip(self.leaves, self.leaf_nbytes):
+            if off + 8 + nbytes <= start:
+                off += 8 + nbytes  # fully before the range: skip cheaply
+                continue
+            if off >= stop:
+                break
+            _emit(struct.pack("<Q", nbytes))
+            if off + nbytes <= start:
+                off += nbytes
+                continue
+            _emit(as_byte_view(materialize_leaf(leaf)))
+
+
+def _snapshot_leaf(leaf: Any) -> Any:
+    """Point-in-time snapshot of one collected leaf without bringing it to
+    host: numpy copies on host (the train loop may mutate it in place, e.g.
+    LocalSGD host params); jax arrays/shards copy ON DEVICE (HBM-to-HBM) —
+    a mere reference would die when a donating jit (HSDPTrainer's update,
+    ``parallel/hsdp.py``) consumes the original buffer mid-stream."""
+    if isinstance(leaf, np.ndarray):
+        return leaf.copy()
+    import jax.numpy as jnp
+
+    if hasattr(leaf, "data") and not hasattr(leaf, "__array__"):
+        return jnp.copy(leaf.data)  # jax Shard -> single-device array copy
+    return jnp.copy(leaf)
+
+
+def plan_pytree(state: Any, snapshot: bool = False) -> PytreePlan:
+    """Build the streaming plan for ``state``.
+
+    ``snapshot`` makes the plan a point-in-time checkpoint that stays valid
+    while training continues: numpy leaves are host-copied, jax leaves are
+    device-copied (see :func:`_snapshot_leaf`); host bytes still materialize
+    one leaf at a time during streaming."""
+    arrays: List[Any] = []
+    skeleton = _extract_arrays(state, arrays)
+    if snapshot:
+        arrays = [_snapshot_leaf(a) for a in arrays]
+    payload = pickle.dumps(skeleton, protocol=pickle.HIGHEST_PROTOCOL)
+    header = (
+        MAGIC
+        + struct.pack("<I", len(payload))
+        + payload
+        + struct.pack("<I", len(arrays))
+    )
+    leaf_nbytes = []
+    for leaf in arrays:
+        dtype_name, shape = _leaf_meta(leaf)
+        nbytes = _resolve_dtype(dtype_name).itemsize
+        for d in shape:
+            nbytes *= d
+        leaf_nbytes.append(nbytes)
+    total = len(header) + sum(8 + n for n in leaf_nbytes)
+    return PytreePlan(
+        header=header, leaves=arrays, leaf_nbytes=leaf_nbytes, total_len=total
+    )
+
+
+def save_pytree(state: Any, stream: BinaryIO) -> None:
+    """Stream-serialize: leaves are materialized to host one at a time as
+    they are written (peak extra host RSS ≈ one leaf)."""
+    plan = plan_pytree(state)
+    stream.write(plan.header)
+    for leaf, nbytes in zip(plan.leaves, plan.leaf_nbytes):
+        arr = materialize_leaf(leaf)
+        assert arr.nbytes == nbytes, (arr.nbytes, nbytes)
+        stream.write(struct.pack("<Q", nbytes))
         stream.write(as_byte_view(arr))
 
 
@@ -193,7 +300,14 @@ def _read_exact(stream: BinaryIO, n: int) -> bytes:
     return out
 
 
-def load_pytree(stream: BinaryIO) -> Any:
+def load_pytree(stream: BinaryIO, leaf_hook: Any = None) -> Any:
+    """Inverse of :func:`save_pytree`, reading payloads straight into
+    preallocated arrays (``readinto``, no intermediate copies).
+
+    ``leaf_hook(arr) -> Any``, if given, maps each array right after its
+    bytes arrive — e.g. ``jax.device_put`` with the healing replica's target
+    sharding — so the host copy of each leaf can be dropped as soon as the
+    next one starts arriving (in-place-on-arrival heal)."""
     magic = _read_exact(stream, len(MAGIC))
     if magic != MAGIC:
         raise ValueError(f"bad checkpoint magic {magic!r}")
@@ -244,7 +358,7 @@ def load_pytree(stream: BinaryIO) -> Any:
                 view[off : off + len(chunk)] = chunk
                 n = len(chunk)
             off += n
-        arrays.append(arr)
+        arrays.append(arr if leaf_hook is None else leaf_hook(arr))
 
     return _restore_arrays(skeleton, arrays)
 
