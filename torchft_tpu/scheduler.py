@@ -1,0 +1,265 @@
+"""Cluster scheduler shims: render/submit FT jobs to SLURM or GKE.
+
+The reference launches replica groups through TorchX components
+(``torchft/torchx.py:17-89`` — one role per replica group with the
+``REPLICA_GROUP_ID`` / ``NUM_REPLICA_GROUPS`` / ``TORCHFT_LIGHTHOUSE`` env
+contract) and a SLURM runner that submits one app per replica group so each
+is an independent failure domain
+(``torchft/examples/slurm/runner.py:22-115``).  torchft_tpu renders the
+same contract for TPU-VM deployments:
+
+- **SLURM**: one sbatch script per replica group (``--requeue`` gives the
+  scheduler-level auto-restart the reference gets from its monitor loop).
+- **GKE**: one Job manifest per replica group against a TPU node pool
+  (``google.com/tpu`` resources + ``backoffLimit`` restarts).
+
+The input is the same shape ``torchft_tpu.launcher`` takes (replicas +
+training cmd + lighthouse), so moving from a single-host supervisor to a
+cluster is a flag change, not a rewrite::
+
+    python -m torchft_tpu.scheduler slurm --replicas 4 \
+        --lighthouse head-node:29510 --out-dir jobs/ -- \
+        python examples/train_ddp.py --steps 1000
+
+Rendering is pure (files written to ``--out-dir``); ``--submit`` execs
+``sbatch``/``kubectl apply`` on each rendered file when those binaries
+exist on PATH.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import shlex
+import shutil
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("torchft_tpu.scheduler")
+
+
+@dataclass
+class JobSpec:
+    """One FT job: N replica groups running ``cmd`` against a lighthouse."""
+
+    replicas: int
+    cmd: List[str]
+    lighthouse: str
+    job_name: str = "torchft-tpu"
+    env: Dict[str, str] = field(default_factory=dict)
+    # SLURM knobs
+    partition: Optional[str] = None
+    nodes_per_replica: int = 1
+    time_limit: str = "24:00:00"
+    max_restarts: int = 10
+    # GKE knobs
+    image: str = "python:3.12"
+    tpu_accelerator: str = "tpu-v5p-slice"
+    tpu_topology: str = "2x2x1"
+    tpu_chips: int = 4
+    namespace: str = "default"
+
+    def contract_env(self, replica_id: int) -> Dict[str, str]:
+        """The env contract every backend must deliver (launcher.py twin,
+        same names as the reference)."""
+        env = {
+            "TORCHFT_LIGHTHOUSE": self.lighthouse,
+            "REPLICA_GROUP_ID": str(replica_id),
+            "NUM_REPLICA_GROUPS": str(self.replicas),
+        }
+        env.update(self.env)
+        return env
+
+
+def render_sbatch(spec: JobSpec) -> List[Tuple[str, str]]:
+    """One sbatch script per replica group (independent failure domains —
+    killing/requeueing one group never touches the others, exactly like the
+    reference's per-replica TorchX apps)."""
+    out = []
+    for rid in range(spec.replicas):
+        env_lines = "\n".join(
+            f"export {k}={shlex.quote(v)}"
+            for k, v in spec.contract_env(rid).items()
+        )
+        partition = (
+            f"#SBATCH --partition={spec.partition}\n" if spec.partition else ""
+        )
+        script = f"""#!/bin/bash
+#SBATCH --job-name={spec.job_name}-rg{rid}
+#SBATCH --nodes={spec.nodes_per_replica}
+#SBATCH --ntasks-per-node=1
+#SBATCH --time={spec.time_limit}
+#SBATCH --requeue
+#SBATCH --open-mode=append
+{partition}
+# torchft_tpu replica group {rid}/{spec.replicas}: requeue on failure is the
+# scheduler-level restart loop; the surviving groups keep training while
+# this one comes back and heals from a live peer.
+{env_lines}
+
+# multi-host replica groups: every node of this allocation joins the same
+# group; group_rank/group_world_size ride on SLURM's own variables
+export TPUFT_GROUP_RANK=${{SLURM_NODEID:-0}}
+export TPUFT_GROUP_WORLD_SIZE=${{SLURM_NNODES:-1}}
+
+srun --kill-on-bad-exit=1 {shlex.join(spec.cmd)}
+"""
+        out.append((f"{spec.job_name}-rg{rid}.sbatch", script))
+    return out
+
+
+def render_gke(spec: JobSpec) -> List[Tuple[str, str]]:
+    """One Kubernetes Job per replica group against a TPU node pool."""
+    import json
+
+    out = []
+    for rid in range(spec.replicas):
+        # json.dumps is valid YAML and escapes correctly (repr is not:
+        # backslashes/quotes in values would corrupt the manifest)
+        env_yaml = "\n".join(
+            f"            - name: {k}\n              value: {json.dumps(str(v))}"
+            for k, v in spec.contract_env(rid).items()
+        )
+        manifest = f"""apiVersion: batch/v1
+kind: Job
+metadata:
+  name: {spec.job_name}-rg{rid}
+  namespace: {spec.namespace}
+  labels:
+    app: {spec.job_name}
+    replica-group: "{rid}"
+spec:
+  # the restart loop: a killed/crashed group re-runs and heals from a peer
+  backoffLimit: {spec.max_restarts}
+  template:
+    metadata:
+      labels:
+        app: {spec.job_name}
+        replica-group: "{rid}"
+    spec:
+      restartPolicy: OnFailure
+      nodeSelector:
+        cloud.google.com/gke-tpu-accelerator: {spec.tpu_accelerator}
+        cloud.google.com/gke-tpu-topology: {spec.tpu_topology}
+      containers:
+        - name: train
+          image: {spec.image}
+          command: {json.dumps(spec.cmd)}
+          env:
+{env_yaml}
+          resources:
+            requests:
+              google.com/tpu: {spec.tpu_chips}
+            limits:
+              google.com/tpu: {spec.tpu_chips}
+"""
+        out.append((f"{spec.job_name}-rg{rid}.yaml", manifest))
+    return out
+
+
+def write_specs(
+    rendered: List[Tuple[str, str]], out_dir: str
+) -> List[str]:
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for name, content in rendered:
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as f:
+            f.write(content)
+        paths.append(path)
+    return paths
+
+
+def submit(backend: str, paths: List[str]) -> None:
+    """Submit rendered specs via the scheduler CLI (sbatch / kubectl)."""
+    if backend == "slurm":
+        tool, args = "sbatch", []
+    else:
+        tool, args = "kubectl", ["apply", "-f"]
+    if shutil.which(tool) is None:
+        raise RuntimeError(
+            f"{tool} not found on PATH; rendered specs are in "
+            f"{os.path.dirname(paths[0])} for manual submission"
+        )
+    for path in paths:
+        subprocess.run([tool, *args, path], check=True)
+        logger.info("submitted %s", path)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        "torchft_tpu.scheduler",
+        description="Render (and optionally submit) FT replica-group jobs "
+        "to a cluster scheduler.",
+    )
+    parser.add_argument("backend", choices=["slurm", "gke"])
+    parser.add_argument("--replicas", type=int, required=True)
+    parser.add_argument("--lighthouse", required=True)
+    parser.add_argument("--job-name", default="torchft-tpu")
+    parser.add_argument("--out-dir", default="jobs")
+    parser.add_argument("--partition", default=None)
+    parser.add_argument("--nodes-per-replica", type=int, default=1)
+    parser.add_argument("--time-limit", default="24:00:00")
+    parser.add_argument("--max-restarts", type=int, default=10)
+    parser.add_argument("--image", default="python:3.12")
+    parser.add_argument("--tpu-accelerator", default="tpu-v5p-slice")
+    parser.add_argument("--tpu-topology", default="2x2x1")
+    parser.add_argument("--tpu-chips", type=int, default=4)
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument(
+        "--env",
+        action="append",
+        default=[],
+        metavar="K=V",
+        help="extra env var for every replica group (repeatable)",
+    )
+    parser.add_argument("--submit", action="store_true")
+    # split at "--" before argparse: REMAINDER after a positional swallows
+    # the option flags too
+    raw = list(sys.argv[1:] if argv is None else argv)
+    cmd: List[str] = []
+    if "--" in raw:
+        split = raw.index("--")
+        raw, cmd = raw[:split], raw[split + 1 :]
+    args = parser.parse_args(raw)
+    logging.basicConfig(level=logging.INFO)
+
+    if not cmd:
+        parser.error("training command required after --")
+
+    env = {}
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        env[k] = v
+
+    spec = JobSpec(
+        replicas=args.replicas,
+        cmd=cmd,
+        lighthouse=args.lighthouse,
+        job_name=args.job_name,
+        env=env,
+        partition=args.partition,
+        nodes_per_replica=args.nodes_per_replica,
+        time_limit=args.time_limit,
+        max_restarts=args.max_restarts,
+        image=args.image,
+        tpu_accelerator=args.tpu_accelerator,
+        tpu_topology=args.tpu_topology,
+        tpu_chips=args.tpu_chips,
+        namespace=args.namespace,
+    )
+    rendered = (
+        render_sbatch(spec) if args.backend == "slurm" else render_gke(spec)
+    )
+    paths = write_specs(rendered, args.out_dir)
+    for p in paths:
+        print(p)
+    if args.submit:
+        submit(args.backend, paths)
+
+
+if __name__ == "__main__":
+    main()
