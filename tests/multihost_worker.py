@@ -38,17 +38,29 @@ def main() -> None:
     p.add_argument("--wait-at", type=int, default=4)
     args = p.parse_args()
 
+    import logging
+    import time as _t
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s g{args.group}r{args.rank} %(name)s: %(message)s",
+    )
+    log = logging.getLogger("multihost_worker")
+    t0 = _t.monotonic()
+
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # sitecustomize pins axon
+    log.info("jax imported (+%.1fs)", _t.monotonic() - t0)
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{args.coord_port}",
         num_processes=2,
         process_id=args.rank,
     )
+    log.info("jax.distributed initialized (+%.1fs)", _t.monotonic() - t0)
 
     import time
 
@@ -88,8 +100,11 @@ def main() -> None:
             state["opt_state"], holder["opt_state"]
         )
 
+    # generous deadlines: 4 jax processes boot concurrently and the whole
+    # suite may be loading the machine — a quorum RPC timing out here makes
+    # the worker exit rc=1 and flakes the kill/heal assertions
     manager = Manager(
-        comm=TCPCommunicator(timeout_s=10.0),
+        comm=TCPCommunicator(timeout_s=30.0),
         load_state_dict=_load,
         state_dict=_save,
         min_replica_size=1,
@@ -100,9 +115,9 @@ def main() -> None:
         store_port=args.store_port,
         rank=args.rank,
         world_size=2,
-        timeout=15.0,
-        quorum_timeout=15.0,
-        connect_timeout=15.0,
+        timeout=60.0,
+        quorum_timeout=60.0,
+        connect_timeout=30.0,
     )
 
     @jax.jit

@@ -102,9 +102,15 @@ def test_multihost_groups_kill_heal(tmp_path) -> None:
         )
         all_procs += group1
 
-        # group 1 dies whole (both hosts) at step 2
-        for p in group1:
-            assert p.wait(timeout=120) == 9, "group 1 should die at step 2"
+        # group 1 dies whole (both hosts) at step 2.  Only the first rank to
+        # reach die_at reliably exits 9: its death makes the OTHER rank's
+        # jax.distributed coordination service terminate that process with
+        # its own fatal exit code (or, if the peer dies mid-barrier, a
+        # manager-timeout exit) — exactly how a whole-host failure cascades
+        # on a real multi-host job.  Assert the group died, not the codes.
+        rcs = [p.wait(timeout=150) for p in group1]
+        assert 9 in rcs, f"group 1 should die at step 2 (rcs={rcs})"
+        assert all(rc != 0 for rc in rcs), f"group 1 should die whole (rcs={rcs})"
 
         # ids seen so far — the dead life's heartbeat may still look fresh
         dead_ids = set(lighthouse._status().get("heartbeats", {}))
