@@ -187,3 +187,81 @@ class TestParameterServer:
             )
         finally:
             ps.shutdown()
+
+
+class TestDualStack:
+    """IPv6/dual-stack binding (reference: torchft/http.py:11-13)."""
+
+    def test_create_listener_dual_stack_accepts_v4(self) -> None:
+        import socket as s
+
+        from torchft_tpu.wire import create_listener
+
+        sock = create_listener("0.0.0.0:0")
+        port = sock.getsockname()[1]
+        try:
+            with s.create_connection(("127.0.0.1", port), timeout=5.0):
+                pass
+            if sock.family == s.AF_INET6:
+                with s.create_connection(("::1", port), timeout=5.0):
+                    pass
+        finally:
+            sock.close()
+
+    def test_create_listener_ipv6_literal(self) -> None:
+        import socket as s
+
+        from torchft_tpu.wire import create_listener
+
+        try:
+            sock = create_listener("[::1]:0")
+        except OSError:
+            import pytest
+
+            pytest.skip("no IPv6 loopback")
+        port = sock.getsockname()[1]
+        try:
+            with s.create_connection(("::1", port), timeout=5.0):
+                pass
+        finally:
+            sock.close()
+
+    def test_lighthouse_on_ipv6(self) -> None:
+        from torchft_tpu.lighthouse import LighthouseClient, LighthouseServer
+
+        try:
+            server = LighthouseServer(
+                bind="[::1]:0", min_replicas=1, join_timeout_ms=50
+            )
+        except OSError:
+            import pytest
+
+            pytest.skip("no IPv6 loopback")
+        try:
+            client = LighthouseClient(f"[::1]:{server.port}", connect_timeout=5.0)
+            client.heartbeat("r0")
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_http_transport_dual_stack(self) -> None:
+        import numpy as np
+
+        from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+        sender = HTTPTransport(timeout=10.0)
+        receiver = HTTPTransport(timeout=10.0)
+        state = {"x": np.arange(10, dtype=np.float32)}
+        try:
+            sender.send_checkpoint([1], step=3, state_dict=state, timeout=5.0)
+            for host in ("127.0.0.1", "[::1]"):
+                out = receiver.recv_checkpoint(
+                    src_rank=0,
+                    metadata=f"http://{host}:{sender.port}",
+                    step=3,
+                    timeout=10.0,
+                )
+                np.testing.assert_array_equal(out["x"], state["x"])
+        finally:
+            sender.shutdown()
+            receiver.shutdown()

@@ -213,10 +213,9 @@ class _TcpMesh:
 
         store = create_store_client(store_addr, timeout=timeout_s)
 
-        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        listener.bind(("0.0.0.0", 0))
-        listener.listen(world_size)
+        from torchft_tpu.wire import create_listener
+
+        listener = create_listener("0.0.0.0:0", backlog=world_size)
         port = listener.getsockname()[1]
         host = socket.gethostname()
         try:
@@ -251,7 +250,7 @@ class _TcpMesh:
                 addr = store.get(f"{peer}", timeout=timeout_s).decode()
                 peer_host, peer_port = addr.rsplit(":", 1)
                 sock = socket.create_connection(
-                    (peer_host, int(peer_port)), timeout=timeout_s
+                    (peer_host.strip("[]"), int(peer_port)), timeout=timeout_s
                 )
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 sock.sendall(struct.pack("<Q", rank))

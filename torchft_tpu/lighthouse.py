@@ -48,6 +48,7 @@ from torchft_tpu.wire import (
     WireError,
     Writer,
     configure_server_socket,
+    create_listener,
     connect,
     raise_if_error,
     recv_frame,
@@ -193,11 +194,7 @@ class LighthouseServer:
         # when a quorum excludes them — see _tick_locked
         self._parked: Dict[object, QuorumMember] = {}
 
-        host, port = bind.rsplit(":", 1)
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, int(port)))
-        self._sock.listen(512)
+        self._sock = create_listener(bind, backlog=512)
         self._port: int = self._sock.getsockname()[1]
 
         self._accept_thread = threading.Thread(

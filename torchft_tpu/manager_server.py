@@ -46,6 +46,7 @@ from torchft_tpu.wire import (
     WireError,
     Writer,
     configure_server_socket,
+    create_listener,
     raise_if_error,
     recv_frame,
     send_error,
@@ -183,11 +184,7 @@ class ManagerServer:
         self._lh_quorum_client: Optional[LighthouseClient] = None
         self._lh_client_lock = threading.Lock()
 
-        host, port = bind.rsplit(":", 1)
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, int(port)))
-        self._sock.listen(64)
+        self._sock = create_listener(bind, backlog=64)
         self._port: int = self._sock.getsockname()[1]
 
         threading.Thread(

@@ -47,11 +47,9 @@ class StoreServer:
     """
 
     def __init__(self, bind: str = "0.0.0.0:0") -> None:
-        host, port = bind.rsplit(":", 1)
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, int(port)))
-        self._sock.listen(512)
+        from torchft_tpu.wire import create_listener
+
+        self._sock = create_listener(bind, backlog=512)
         self._port: int = self._sock.getsockname()[1]
         self._data: Dict[str, bytes] = {}
         self._cond = threading.Condition()

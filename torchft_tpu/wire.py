@@ -379,6 +379,54 @@ def raise_if_error(msg_type: int, r: Reader) -> None:
     raise WireError(code, msg)
 
 
+def create_listener(bind: str, backlog: int = 512) -> socket.socket:
+    """Bound+listening server socket from a ``host:port`` string, dual-stack
+    where possible (the reference binds ``[::]`` with v6only off so one
+    socket serves both families, ``torchft/http.py:11-13``).
+
+    ``0.0.0.0`` / ``[::]`` / empty host → wildcard dual-stack (falls back to
+    IPv4-only on kernels without IPv6); an explicit IPv6 literal (in
+    brackets) or any address that resolves to v6 binds AF_INET6; everything
+    else AF_INET."""
+    raw_host, _, port_str = bind.rpartition(":")
+    host = raw_host.strip("[]")
+    port = int(port_str)
+    wildcard = host in ("", "0.0.0.0", "::")
+    candidates = []
+    if wildcard:
+        candidates.append((socket.AF_INET6, "::", True))
+        candidates.append((socket.AF_INET, "0.0.0.0", False))
+    else:
+        try:
+            infos = socket.getaddrinfo(
+                host, port, type=socket.SOCK_STREAM, flags=socket.AI_PASSIVE
+            )
+        except socket.gaierror:
+            infos = [(socket.AF_INET, None, None, None, (host, port))]
+        # v4 results first: a hostname like "localhost" resolving to ::1
+        # first must not silently become a v6-only listener that refuses
+        # the v4 clients it served before (an explicit [v6] literal still
+        # resolves to AF_INET6 only)
+        infos = sorted(infos, key=lambda i: i[0] != socket.AF_INET)
+        for family, *_rest, sockaddr in infos:
+            candidates.append((family, sockaddr[0], False))
+    last_err: Optional[OSError] = None
+    for family, bind_host, dual in candidates:
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if dual and hasattr(socket, "IPV6_V6ONLY"):
+                # dual-stack: one wildcard socket accepts v4-mapped peers too
+                sock.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_V6ONLY, 0)
+            sock.bind((bind_host, port))
+            sock.listen(backlog)
+            return sock
+        except OSError as e:
+            last_err = e
+            sock.close()
+    raise last_err if last_err else OSError(f"cannot bind {bind!r}")
+
+
 def connect(addr: str, timeout: float) -> socket.socket:
     """Dial ``host:port`` with a connect deadline.
 
