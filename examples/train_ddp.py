@@ -67,6 +67,12 @@ def main() -> None:
     )
     parser.add_argument("--min-replicas", type=int, default=1)
     parser.add_argument(
+        "--comm-timeout",
+        type=float,
+        default=30.0,
+        help="per-op userspace timeout; a wedged peer is evicted after this",
+    )
+    parser.add_argument(
         "--platform",
         default=None,
         help="force a jax platform (e.g. cpu) — useful when several replica "
@@ -84,7 +90,7 @@ def main() -> None:
     holder = {"params": params, "opt_state": tx.init(params)}
 
     manager = Manager(
-        comm=TCPCommunicator(timeout_s=30.0),
+        comm=TCPCommunicator(timeout_s=args.comm_timeout),
         load_state_dict=lambda s: holder.update(s),
         state_dict=lambda: dict(holder),
         min_replica_size=args.min_replicas,

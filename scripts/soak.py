@@ -1,14 +1,27 @@
-"""Randomized chaos soak: replicas under continuous random kills.
+"""Randomized chaos soak: replicas under continuous random failures.
 
 Not part of CI (wall-clock bound); run manually to shake out races:
 
     python scripts/soak.py --seconds 120 --replicas 3 --kill-every 6
 
 Each replica trains a small model through the full stack (real lighthouse,
-managers, TCP communicators, HTTP heal transports).  A chaos thread kills a
-random replica (hard, via its Runner) on a Poisson schedule.  At the end all
-survivors must hold identical state and have committed a healthy fraction of
-attempted steps.
+managers, TCP communicators, HTTP heal transports).  A chaos thread injects
+a random failure on a Poisson schedule, drawn from the same classes the
+reference's Monarch FailureActor exercises
+(``examples/monarch/utils/failure.py:24-60``):
+
+- ``kill``      hard death + restart with fresh state (heals from a peer)
+- ``wedge``     deadlock-class: the replica parks mid-step AFTER joining the
+                quorum, so peers block in the gradient ring until their
+                userspace op timeout aborts the collective and the next
+                quorum evicts the wedged member; it later resumes, rejoins,
+                and heals
+- ``commabort`` comm-kill: the communicator is aborted under the replica
+                (NIC-failure analog); the step fails and the next quorum
+                reconfigures with no process restart
+
+At the end all survivors must hold identical state and have committed a
+healthy fraction of attempted steps.
 """
 
 from __future__ import annotations
@@ -45,6 +58,9 @@ class KillSignal(Exception):
     pass
 
 
+FAILURE_CLASSES = ("kill", "wedge", "commabort")
+
+
 class SoakReplica:
     def __init__(
         self, idx: int, lighthouse_addr: str, stop: threading.Event, backend: str = "tcp"
@@ -54,7 +70,11 @@ class SoakReplica:
         self.lighthouse_addr = lighthouse_addr
         self.stop = stop
         self.kill_flag = threading.Event()
+        self.wedge_flag = threading.Event()
+        self.wedge_secs = 0.0
         self.restarts = 0
+        self.wedges = 0
+        self.comm_aborts = 0
         self.commits = 0
         self.attempts = 0
         self.final_state = None
@@ -81,6 +101,7 @@ class SoakReplica:
             comm = CppCommunicator(timeout_s=15.0)
         else:
             comm = TCPCommunicator(timeout_s=15.0)
+        self.comm = comm
         manager = Manager(
             comm=comm,
             load_state_dict=lambda s: holder.update(s),
@@ -100,6 +121,12 @@ class SoakReplica:
                 time.sleep(0.02)
                 self.attempts += 1
                 opt.start_step()
+                if self.wedge_flag.is_set():
+                    # deadlock-class failure: park AFTER joining the quorum,
+                    # so peers block in the ring until their op timeout
+                    self.wedge_flag.clear()
+                    self.wedges += 1
+                    time.sleep(self.wedge_secs)
                 grads = jax.tree_util.tree_map(
                     lambda p: jnp.full_like(p, 0.001 * (self.idx + 1)),
                     holder["params"],
@@ -119,6 +146,11 @@ def main() -> None:
     parser.add_argument("--kill-every", type=float, default=6.0)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--backend", choices=["tcp", "cpp"], default="tcp")
+    parser.add_argument(
+        "--classes",
+        default=",".join(FAILURE_CLASSES),
+        help="comma list of failure classes to mix (kill,wedge,commabort)",
+    )
     args = parser.parse_args()
 
     lighthouse = LighthouseServer(
@@ -135,7 +167,9 @@ def main() -> None:
     ]
 
     rng = random.Random(args.seed)
-    kills = [0]
+    classes = [c.strip() for c in args.classes.split(",") if c.strip()]
+    assert all(c in FAILURE_CLASSES for c in classes), classes
+    counts = {c: 0 for c in classes}
 
     def chaos() -> None:
         while not stop.is_set():
@@ -143,9 +177,21 @@ def main() -> None:
             if stop.is_set():
                 return
             victim = rng.choice(replicas)
-            victim.kill_flag.set()
-            kills[0] += 1
-            print(f"[chaos] killed replica {victim.idx} (total {kills[0]})", flush=True)
+            cls = rng.choice(classes)
+            counts[cls] += 1
+            if cls == "kill":
+                victim.kill_flag.set()
+            elif cls == "wedge":
+                # sometimes longer than the 15s op timeout (peer-side abort
+                # + eviction), sometimes a mere straggler stall
+                victim.wedge_secs = rng.uniform(2.0, 22.0)
+                victim.wedge_flag.set()
+            else:  # commabort
+                victim.comm_aborts += 1
+                comm = getattr(victim, "comm", None)
+                if comm is not None:
+                    comm.abort("chaos: injected comm failure")
+            print(f"[chaos] {cls} replica {victim.idx} ({counts})", flush=True)
 
     chaos_thread = threading.Thread(target=chaos, daemon=True)
     chaos_thread.start()
@@ -162,8 +208,9 @@ def main() -> None:
     total_commits = sum(r.commits for r in replicas)
     total_attempts = sum(r.attempts for r in replicas)
     print(
-        f"soak done: {args.seconds}s, kills={kills[0]}, "
+        f"soak done: {args.seconds}s, injected={counts}, "
         f"restarts={sum(r.restarts for r in replicas)}, "
+        f"wedges={sum(r.wedges for r in replicas)}, "
         f"commits={total_commits}/{total_attempts} attempts"
     )
     assert total_commits > 0, "no steps ever committed"

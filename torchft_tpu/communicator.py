@@ -46,6 +46,7 @@ import numpy as np
 
 from torchft_tpu.futures import TimerHandle, schedule_timeout
 from torchft_tpu.store import create_store_client
+from torchft_tpu.wire import create_listener
 from torchft_tpu.work import DummyWork, Work
 
 logger = logging.getLogger(__name__)
@@ -212,8 +213,6 @@ class _TcpMesh:
         self.peers: Dict[int, socket.socket] = {}
 
         store = create_store_client(store_addr, timeout=timeout_s)
-
-        from torchft_tpu.wire import create_listener
 
         listener = create_listener("0.0.0.0:0", backlog=world_size)
         port = listener.getsockname()[1]

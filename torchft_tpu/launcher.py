@@ -39,6 +39,8 @@ class ReplicaSpec:
     replica_group_id: int
     cmd: List[str]
     env: Dict[str, str] = field(default_factory=dict)
+    # when set, the group's stdout/stderr append here (survives restarts)
+    log_path: Optional[str] = None
 
 
 class ReplicaSupervisor:
@@ -73,6 +75,21 @@ class ReplicaSupervisor:
         logger.info(
             "launching replica group %d: %s", spec.replica_group_id, spec.cmd
         )
+        if spec.log_path:
+            try:
+                with open(spec.log_path, "ab") as log:
+                    return subprocess.Popen(
+                        spec.cmd, env=env, stdout=log, stderr=subprocess.STDOUT
+                    )
+            except OSError as e:
+                # a broken log sink (deleted dir, full disk) must not take
+                # down supervision of every other group — run unlogged
+                logger.warning(
+                    "replica group %d: cannot open log %s (%s); running unlogged",
+                    spec.replica_group_id,
+                    spec.log_path,
+                    e,
+                )
         return subprocess.Popen(spec.cmd, env=env)
 
     def run(self) -> int:

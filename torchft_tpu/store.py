@@ -25,6 +25,7 @@ from typing import Dict, Optional
 
 from torchft_tpu.wire import (
     ErrCode,
+    create_listener,
     MsgType,
     Reader,
     RpcClient,
@@ -47,8 +48,6 @@ class StoreServer:
     """
 
     def __init__(self, bind: str = "0.0.0.0:0") -> None:
-        from torchft_tpu.wire import create_listener
-
         self._sock = create_listener(bind, backlog=512)
         self._port: int = self._sock.getsockname()[1]
         self._data: Dict[str, bytes] = {}
