@@ -19,3 +19,19 @@ def test_joint_ft_spmd_kill_heal() -> None:
     )
     assert facts["restarts"] == 1
     assert facts["healed"]
+
+
+def test_joint_ft_spmd_quantized_outer_ring() -> None:
+    """HSDP with the int8 outer ring (quantize_outer=True): every replica
+    applies the identical requantized averaged stream, so sharded state
+    stays bit-identical across replicas — the assertion inside the drill."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    facts = joint_ft_spmd_drill(
+        n_devices=8,
+        num_replicas=2,
+        num_steps=5,
+        kill_replica=None,
+        quantize_outer=True,
+    )
+    assert facts["restarts"] == 0

@@ -47,6 +47,7 @@ def joint_ft_spmd_drill(
     kill_at_step: int = 2,
     step_time_s: float = 0.05,
     timeout_s: float = 30.0,
+    quantize_outer: bool = False,
 ) -> Dict[str, Any]:
     """Run the drill and return summary facts (asserts internally).
 
@@ -115,7 +116,12 @@ def joint_ft_spmd_drill(
             )
             zombies.append(manager)
             trainer = HSDPTrainer(
-                model, optax.sgd(0.01), mesh, manager, key=jax.random.PRNGKey(0)
+                model,
+                optax.sgd(0.01),
+                mesh,
+                manager,
+                key=jax.random.PRNGKey(0),
+                quantize_outer=quantize_outer,
             )
             # distinct per-replica batch: equality at the end REQUIRES the
             # replica-dim average to have run

@@ -143,6 +143,29 @@ class HSDPTrainer:
     Per step: quorum (async, overlapped) → compiled grad step (FSDP/TP over
     ICI) → replica-dim gradient average (Manager over DCN) → commit-gated
     compiled update.
+
+    **Why the DCN ring sits on the per-step critical path.** The commit
+    vote must fence every in-flight collective (a late failure after the
+    vote would commit unaveraged gradients — ``Manager.should_commit``;
+    the reference synchronizes its accelerator stream at the same point,
+    ``torchft/manager.py:888-893``), the update needs the averaged
+    gradients, and the next forward needs the update.  Overlapping the
+    ring with the next step's compute therefore requires either stale
+    gradients or unfenced commits — both unsound for per-step DDP.  The
+    framework's levers instead:
+
+    - ``quantize_outer=True``: the int8 wire format (native host kernels +
+      windowed wire/reduce pipelining, ``collectives.py``) cuts ring bytes
+      4x and round-2 wall time ~2.4x.  Every replica applies the identical
+      requantized stream, so replicas stay bit-identical; accuracy vs the
+      f32 ring is rowwise-int8 (the reference ships fp8 outer syncs with
+      the same caveat).
+    - bucket-level pipelining inside ``allreduce_pytree``: D2H of bucket
+      k+1 overlaps the ring of bucket k.
+    - for delay-tolerant training, :class:`~torchft_tpu.local_sgd.DiLoCo`
+      (and streaming fragments) moves the outer sync fully off the
+      critical path with its τ-delay worker — that is the sanctioned
+      ring/compute-overlap mode, as in the reference.
     """
 
     def __init__(
@@ -153,11 +176,13 @@ class HSDPTrainer:
         manager: Manager,
         key: Optional[jax.Array] = None,
         params: Optional[Any] = None,
+        quantize_outer: bool = False,
     ) -> None:
         self.model = model
         self.tx = tx
         self.mesh = mesh
         self.manager = manager
+        self.quantize_outer = quantize_outer
         if params is None:
             assert key is not None, "need key or params"
             params = shard_init(model, key, mesh)
@@ -191,7 +216,9 @@ class HSDPTrainer:
         """One fault-tolerant step; returns (loss, committed)."""
         self.manager.start_quorum()
         loss, grads = self._grad_step(self.holder["params"], batch)
-        grads = ft_allreduce(self.manager, grads)
+        grads = ft_allreduce(
+            self.manager, grads, should_quantize=self.quantize_outer
+        )
         committed = self.manager.should_commit()
         if committed:
             params, opt_state = self._update_step(
