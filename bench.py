@@ -484,8 +484,11 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
     # the FT phase below must not read donated buffers
     ff_params = jax.tree_util.tree_map(jnp.copy, params)
     opt_state = jax.jit(tx.init)(ff_params)
-    loss, grads = grad_step(ff_params, batch_data)  # compile
-    ff_params, opt_state = update_step(ff_params, opt_state, grads)
+    # several warmup steps: the first post-compile iterations can run slow
+    # (autotuning/tunnel warm-up) and would skew a 20-step measurement
+    for _ in range(4):
+        loss, grads = grad_step(ff_params, batch_data)
+        ff_params, opt_state = update_step(ff_params, opt_state, grads)
     jax.block_until_ready(ff_params)
 
     start = time.perf_counter()
@@ -521,7 +524,8 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
         grads = ft_allreduce(manager, grads)
         opt.step(holder, grads)
 
-    ft_step()  # warm the protocol path
+    for _ in range(4):  # warm the protocol path + post-compile iterations
+        ft_step()
     jax.block_until_ready(holder["params"])
 
     start = time.perf_counter()

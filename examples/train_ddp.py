@@ -73,6 +73,12 @@ def main() -> None:
         help="per-op userspace timeout; a wedged peer is evicted after this",
     )
     parser.add_argument(
+        "--step-time",
+        type=float,
+        default=0.0,
+        help="minimum seconds per step (paces chaos-test scenarios)",
+    )
+    parser.add_argument(
         "--platform",
         default=None,
         help="force a jax platform (e.g. cpu) — useful when several replica "
@@ -108,7 +114,11 @@ def main() -> None:
     loss_and_grad = jax.jit(jax.value_and_grad(model.loss))
 
     batches = list(batch_indices(sampler, args.batch_size))
+    import time
+
     while manager.current_step() < args.steps:
+        if args.step_time > 0:
+            time.sleep(args.step_time)
         step = manager.current_step()
         idxs = batches[step % len(batches)]
         batch = (jnp.asarray(x[idxs]), jnp.asarray(y[idxs]))

@@ -168,7 +168,9 @@ def main() -> None:
 
     rng = random.Random(args.seed)
     classes = [c.strip() for c in args.classes.split(",") if c.strip()]
-    assert all(c in FAILURE_CLASSES for c in classes), classes
+    assert classes and all(c in FAILURE_CLASSES for c in classes), (
+        f"--classes must name at least one of {FAILURE_CLASSES}: {args.classes!r}"
+    )
     counts = {c: 0 for c in classes}
 
     def chaos() -> None:
@@ -187,10 +189,12 @@ def main() -> None:
                 victim.wedge_secs = rng.uniform(2.0, 22.0)
                 victim.wedge_flag.set()
             else:  # commabort
-                victim.comm_aborts += 1
                 comm = getattr(victim, "comm", None)
-                if comm is not None:
-                    comm.abort("chaos: injected comm failure")
+                if comm is None:
+                    counts[cls] -= 1  # victim not initialized yet: no-op
+                    continue
+                victim.comm_aborts += 1
+                comm.abort("chaos: injected comm failure")
             print(f"[chaos] {cls} replica {victim.idx} ({counts})", flush=True)
 
     chaos_thread = threading.Thread(target=chaos, daemon=True)

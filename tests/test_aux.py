@@ -197,16 +197,23 @@ class TestDualStack:
 
         from torchft_tpu.wire import create_listener
 
+        # probe v4 availability independently, so a dual-stack listener
+        # refusing v4 (the regression this test guards) still FAILS rather
+        # than reading as "no IPv4 loopback"
+        try:
+            probe = s.socket(s.AF_INET, s.SOCK_STREAM)
+            probe.bind(("127.0.0.1", 0))
+            probe.close()
+        except OSError:
+            import pytest
+
+            pytest.skip("no IPv4 loopback")
+
         sock = create_listener("0.0.0.0:0")
         port = sock.getsockname()[1]
         try:
-            try:
-                with s.create_connection(("127.0.0.1", port), timeout=5.0):
-                    pass
-            except OSError:
-                import pytest
-
-                pytest.skip("no IPv4 loopback")
+            with s.create_connection(("127.0.0.1", port), timeout=5.0):
+                pass
             if sock.family == s.AF_INET6:
                 with s.create_connection(("::1", port), timeout=5.0):
                     pass

@@ -170,14 +170,16 @@ def test_sigstop_process_wedge_evicts_and_heals(tmp_path) -> None:
     server = LighthouseServer(
         bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=500, quorum_tick_ms=20
     )
-    # enough steps that the healthy replica cannot FINISH during the freeze
-    # (the victim must rejoin a live peer to heal — that's the scenario)
+    # paced steps so the healthy replica cannot FINISH during the freeze
+    # even on a fast idle machine (the victim must rejoin a live peer to
+    # heal — that's the scenario): 150 steps x >=0.15s >= 22s >> 12s freeze
     cmd = [
         sys.executable,
         str(REPO / "examples" / "train_ddp.py"),
         "--steps", "150",
         "--platform", "cpu",
         "--comm-timeout", "5",
+        "--step-time", "0.15",
     ]
     logs = {i: tmp_path / f"rg{i}.log" for i in range(2)}
     specs = [

@@ -75,12 +75,10 @@ class ReplicaSupervisor:
         logger.info(
             "launching replica group %d: %s", spec.replica_group_id, spec.cmd
         )
+        log = None
         if spec.log_path:
             try:
-                with open(spec.log_path, "ab") as log:
-                    return subprocess.Popen(
-                        spec.cmd, env=env, stdout=log, stderr=subprocess.STDOUT
-                    )
+                log = open(spec.log_path, "ab")
             except OSError as e:
                 # a broken log sink (deleted dir, full disk) must not take
                 # down supervision of every other group — run unlogged
@@ -90,7 +88,15 @@ class ReplicaSupervisor:
                     spec.log_path,
                     e,
                 )
-        return subprocess.Popen(spec.cmd, env=env)
+        try:
+            if log is not None:
+                return subprocess.Popen(
+                    spec.cmd, env=env, stdout=log, stderr=subprocess.STDOUT
+                )
+            return subprocess.Popen(spec.cmd, env=env)
+        finally:
+            if log is not None:
+                log.close()  # the child holds its own fd
 
     def run(self) -> int:
         """Run until every group exits cleanly (rc 0) or is out of restarts.
