@@ -234,7 +234,7 @@ def run_fleet(
     worker_platform: Optional[str],
     kill_every: int = 0,
     replicas: int = 2,
-    deadline_s: float = 420.0,
+    deadline_s: float = 360.0,
 ) -> Dict[str, Any]:
     """Run a fleet of replica-group subprocesses to ``target_steps``; if
     ``kill_every`` > 0, SIGKILL replica 1 every ``kill_every`` survivor
@@ -400,6 +400,7 @@ def _fleet_metrics(
     # step back (covers the failed step, both reconfigures, and the heal
     # pause); heal-in = survivor steps the victim missed
     heal_ins: List[int] = []
+    heal_secs: List[float] = []
     overheads: List[float] = []
     for kill in kills:
         back = [(s, t) for (s, t) in ev1 if t > kill["ts"]]
@@ -410,6 +411,7 @@ def _fleet_metrics(
                 default=kill["survivor_step"],
             )
             heal_ins.append(max(0, survivor_at_rejoin - kill["survivor_step"]))
+            heal_secs.append(rejoin_ts - kill["ts"])
         if t_step is not None:
             if rejoin_ts is not None:
                 window_end = rejoin_ts + 3 * t_step
@@ -422,7 +424,11 @@ def _fleet_metrics(
             ]
             overheads.append(sum(max(0.0, dt - t_step) for dt in dis))
     if heal_ins:
+        # heal-in in steps scales with the survivor's step time; seconds is
+        # the environment-independent number (process respawn + jax init +
+        # rejoin + heal transfer)
         result["mean_heal_in_steps"] = round(sum(heal_ins) / len(heal_ins), 1)
+        result["mean_heal_in_s"] = round(sum(heal_secs) / len(heal_secs), 1)
         result["heal_ins"] = heal_ins
     if overheads:
         result["overhead_per_kill_s"] = round(
@@ -593,6 +599,8 @@ def main() -> None:
         }
         if faulted.get("mean_heal_in_steps") is not None:
             faults["mean_heal_in_steps"] = faulted["mean_heal_in_steps"]
+        if faulted.get("mean_heal_in_s") is not None:
+            faults["mean_heal_in_s"] = faulted["mean_heal_in_s"]
         ratio = faulted.get("ratio_per_100step_kill")
 
     if ratio is None:
