@@ -115,9 +115,9 @@ def main() -> None:
         store_port=args.store_port,
         rank=args.rank,
         world_size=2,
-        timeout=60.0,
-        quorum_timeout=60.0,
-        connect_timeout=30.0,
+        timeout=120.0,
+        quorum_timeout=150.0,
+        connect_timeout=60.0,
     )
 
     @jax.jit

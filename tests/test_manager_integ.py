@@ -645,3 +645,41 @@ def test_multi_rank_groups_recovery(lighthouse) -> None:
     assert groups[1].restarts == 1
     for rank in range(2):
         _assert_all_equal([states[0][rank], states[1][rank]])
+
+
+def test_protocol_overhead_stays_hot(lighthouse) -> None:
+    """The per-step protocol (quorum RPC + commit barrier) must run on warm
+    connections: ~1 ms/step on localhost (benchmarks/proto_bench.py records
+    0.8-1.4 ms).  The generous 20 ms bound catches the failure mode that
+    matters — a reconnect or re-reconfigure sneaking onto the per-step path
+    (round 1 measured ~100 ms/step that way).  Reference analog: the
+    fast-quorum single-round-trip path (src/lighthouse.rs:204-215)."""
+    import time
+
+    holder: Dict[str, object] = {}
+    manager = Manager(
+        comm=TCPCommunicator(timeout_s=30.0),
+        load_state_dict=holder.update,
+        state_dict=lambda: dict(holder),
+        min_replica_size=1,
+        replica_id="proto_hot_0",
+        lighthouse_addr=lighthouse.local_address(),
+    )
+    try:
+        for _ in range(10):
+            manager.start_quorum()
+            assert manager.should_commit()
+        steps = 50
+        times = []
+        for _ in range(steps):
+            start = time.perf_counter()
+            manager.start_quorum()
+            assert manager.should_commit()
+            times.append(time.perf_counter() - start)
+        # median, not mean: robust to scheduler stalls when the suite loads
+        # the shared box — the regression this guards (a reconnect or
+        # reconfigure on every step) shifts the whole distribution
+        per_step = sorted(times)[steps // 2]
+        assert per_step < 0.020, f"protocol {per_step*1e3:.1f} ms/step (cold path?)"
+    finally:
+        manager.shutdown()

@@ -699,6 +699,11 @@ class Manager:
     @traced("torchft::manager::should_commit")
     def should_commit(self, timeout: Optional[float] = None) -> bool:
         """Vote on committing this step (``manager.py:855-943``)."""
+        # the vote depends on this step's quorum results (participation
+        # facts, healing state) — wait it even if no allreduce ran this step
+        # (e.g. a protocol-only or fully-quantized step); otherwise the vote
+        # can read a stale participant count and spuriously fail
+        self.wait_quorum()
         # fence all in-flight collectives, then recovery, before voting
         self._fence_pending_works()
         if self._recovery_event is not None:
