@@ -1,10 +1,12 @@
 """Device mesh construction for the intra-replica-group axes.
 
 A replica group owns one slice of TPUs; inside it we build a
-``jax.sharding.Mesh`` with up to four axes:
+``jax.sharding.Mesh`` with up to six axes:
 
+- ``pp``   — pipeline parallelism (layer stages, GPipe microbatching)
 - ``dp``   — within-group data parallelism (batch dim)
 - ``fsdp`` — parameter/optimizer sharding (the FSDP dimension of HSDP)
+- ``ep``   — expert parallelism (MoE expert dispatch via all_to_all)
 - ``tp``   — tensor (megatron) parallelism for the matmuls
 - ``sp``   — sequence/context parallelism for long sequences (ring
   attention over ``ppermute``)
@@ -29,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 @dataclass(frozen=True)
 class MeshAxes:
+    pp: int = 1
     dp: int = 1
     fsdp: int = 1
     tp: int = 1
@@ -37,10 +40,10 @@ class MeshAxes:
 
     @property
     def total(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp * self.ep
+        return self.pp * self.dp * self.fsdp * self.tp * self.sp * self.ep
 
 
-AXIS_NAMES: Tuple[str, ...] = ("dp", "fsdp", "ep", "sp", "tp")
+AXIS_NAMES: Tuple[str, ...] = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 def make_mesh(
@@ -49,23 +52,28 @@ def make_mesh(
     tp: int = 1,
     sp: int = 1,
     ep: int = 1,
+    pp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a mesh with axes (dp, fsdp, ep, sp, tp).
+    """Build a mesh with axes (pp, dp, fsdp, ep, sp, tp).
 
     Axis order puts ``tp`` innermost so tensor-parallel collectives ride the
     fastest ICI links, then ``sp`` (ring attention neighbor exchanges) and
-    ``ep`` (MoE all_to_all), with ``dp``/``fsdp`` outermost — the standard
-    layout recipe for TPU pods.
+    ``ep`` (MoE all_to_all), with ``dp``/``fsdp`` next and ``pp`` outermost
+    (stage hops are low-volume point-to-point activation sends, the one
+    traffic class that tolerates the slowest links) — the standard layout
+    recipe for TPU pods.
     """
-    axes = MeshAxes(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
+    axes = MeshAxes(pp=pp, dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
     if devices is None:
         devices = jax.devices()
     if axes.total > len(devices):
         raise ValueError(
             f"mesh needs {axes.total} devices, only {len(devices)} available"
         )
-    devices = np.asarray(devices[: axes.total]).reshape(dp, fsdp, ep, sp, tp)
+    devices = np.asarray(devices[: axes.total]).reshape(
+        pp, dp, fsdp, ep, sp, tp
+    )
     return Mesh(devices, AXIS_NAMES)
 
 
