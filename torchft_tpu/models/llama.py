@@ -22,6 +22,7 @@ Design choices for the TPU/XLA compilation model:
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
@@ -185,6 +186,26 @@ class Llama:
             [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
         ).astype(x.dtype)
 
+    def _use_flash(self, seq: int) -> bool:
+        """Dispatch to the fused Pallas kernel (``ops/flash_attention.py``)
+        when it applies: TPU backend (or forced), flash-friendly shapes, no
+        ring attention.  ``TORCHFT_FLASH`` = 1 forces on (interpret mode off
+        TPU), 0 kills it, unset = auto."""
+        cfg = self.config
+        if cfg.sp_axis is not None:
+            return False
+        env = os.environ.get("TORCHFT_FLASH", "")
+        if env == "0":
+            return False
+        if seq < 128 or seq % min(512, seq):
+            return False
+        if env == "1":
+            return True
+        # auto: only for single-device programs — a pallas_call is not
+        # SPMD-partitionable, so inside a tp/fsdp-sharded jit it would force
+        # operand replication (use TORCHFT_FLASH=1 + shard_map to override)
+        return jax.default_backend() == "tpu" and jax.device_count() == 1
+
     def _attention(
         self,
         q: jax.Array,
@@ -194,6 +215,16 @@ class Llama:
     ) -> jax.Array:
         """Causal GQA attention. q: [B,S,H,D], k/v: [B,S,KV,D]."""
         cfg = self.config
+
+        if self._use_flash(q.shape[1]):
+            from torchft_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(
+                q, k, v,
+                causal=True,
+                interpret=jax.default_backend() != "tpu",
+            )
+
         groups = cfg.n_heads // cfg.n_kv_heads
         k = jnp.repeat(k, groups, axis=2)
         v = jnp.repeat(v, groups, axis=2)

@@ -1,0 +1,421 @@
+"""Fused causal GQA flash attention (Pallas, TPU) — forward and backward.
+
+The naive attention path materializes the [B, H, S, S] score matrix in HBM
+(~400 MB per layer at S=1024 in the bench config) — pure HBM-bandwidth tax.
+This is the standard flash construction tiled for the TPU: the grid's k
+dimension is innermost (the TPU grid is a sequential loop, so VMEM scratch
+carries the online-softmax accumulators across k-blocks), fp32
+accumulation, bf16 MXU matmuls.  The reference's GPU analog is
+torch SDPA/flash; here it is a first-party kernel because the framework is
+standalone (SURVEY.md §2.2 Triton-kernels row).
+
+GQA is handled in the BlockSpec index maps: k/v blocks for q-head ``h``
+are fetched from kv-head ``h // groups`` directly, so grouped K/V are
+never repeated to full head count in HBM (the naive path's ``jnp.repeat``
+costs ``groups``× K/V bandwidth).
+
+Backward is the standard two-kernel flash scheme over the saved
+logsumexp: ``dq`` accumulates over k-blocks; ``dk``/``dv`` accumulate over
+(q-head-in-group × q-block) so each kv-head's gradient sums its whole GQA
+group without materializing per-q-head copies.  Causally-dead blocks are
+skipped with ``pl.when`` in both directions.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128  # accumulator minor dim (TPU lane width)
+# rowwise stats (lse, delta) carry a trailing 8-lane dim: Mosaic requires
+# the last block dim be 128-divisible OR equal to the full array dim, and a
+# [B,H,S]-shaped output tiled (1,1,bq) satisfies neither
+_ROW_LANES = 8
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref,  # [1, 1, bq, D]
+    k_ref,  # [1, 1, bk, D]
+    v_ref,  # [1, 1, bk, D]
+    o_ref,  # [1, 1, bq, D]
+    lse_ref,  # [1, 1, bq, _ROW_LANES]
+    m_scr,  # VMEM [bq, _LANES] f32: running row max
+    l_scr,  # VMEM [bq, _LANES] f32: running denominator
+    acc_scr,  # VMEM [bq, D] f32: running (unnormalized) output
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # with causality, k-blocks wholly above the diagonal are dead
+    live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0]  # [bq, D]
+        k = k_ref[0, 0]  # [bk, D]
+        v = v_ref[0, 0]
+
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )  # [bq, bk] f32
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        correction = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_new = l_scr[:, :1] * correction + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * correction + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        denom = jnp.where(l > 0.0, l, 1.0)  # fully-masked rows guard
+        o_ref[0, 0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m + jnp.log(denom), (m.shape[0], _ROW_LANES)
+        )
+
+
+def _fwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """q [B,H,S,D], k/v [B,KV,S,D] → (o [B,H,S,D], lse [B,H,S])."""
+    B, H, S, D = q.shape
+    KV = k.shape[1]
+    groups = H // KV
+    nq, nk = S // block_q, S // block_k
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=nk,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, h, qi, ki: (b, h // groups, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, h, qi, ki: (b, h // groups, ki, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, block_q, _ROW_LANES),
+                lambda b, h, qi, ki: (b, h, qi, 0),
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, _ROW_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p_ds(
+    q, k, lse, do, v, delta, sm_scale, causal, qi, ki, block_q, block_k
+):
+    """Shared backward math for one (q-block, k-block) pair: the normalized
+    probabilities ``p`` and score-gradient ``ds`` (both [bq, bk], f32).
+    ``lse``/``delta`` are [bq, 1] column vectors."""
+    s = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * sm_scale
+    )
+    if causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    p = jnp.exp(s - lse)  # normalized probabilities
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, bk]
+    ds = p * (dp - delta) * sm_scale
+    return p, ds
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, dq_ref, dq_scr,
+    *, sm_scale, causal, block_q, block_k, num_k_blocks,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _accumulate():
+        _, ds = _recompute_p_ds(
+            q_ref[0, 0], k_ref[0, 0], lse_ref[0, 0][:, :1], do_ref[0, 0],
+            v_ref[0, 0], delta_ref[0, 0][:, :1], sm_scale, causal, qi, ki,
+            block_q, block_k,
+        )
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, lse_ref, do_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, sm_scale, causal, block_q, block_k, num_q_blocks, inner_steps,
+):
+    ki = pl.program_id(2)
+    inner = pl.program_id(3)  # flattened (g, qi): sums the whole GQA group
+    qi = inner % num_q_blocks
+
+    @pl.when(inner == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (not causal) or (qi * block_q + block_q - 1 >= ki * block_k)
+
+    @pl.when(live)
+    def _accumulate():
+        p, ds = _recompute_p_ds(
+            q_ref[0, 0], k_ref[0, 0], lse_ref[0, 0][:, :1], do_ref[0, 0],
+            v_ref[0, 0], delta_ref[0, 0][:, :1], sm_scale, causal, qi, ki,
+            block_q, block_k,
+        )
+        do = do_ref[0, 0]
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # p^T @ do: [bk, D]
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0, 0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # ds^T @ q: [bk, D]
+
+    @pl.when(inner == inner_steps - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd(
+    sm_scale, causal, block_q, block_k, interpret, residuals, do
+):
+    q, k, v, o, lse = residuals
+    B, H, S, D = q.shape
+    KV = k.shape[1]
+    groups = H // KV
+    nq, nk = S // block_q, S // block_k
+
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32),
+            axis=-1,
+            keepdims=True,
+        ),
+        (B, H, S, _ROW_LANES),
+    )
+
+    q_map = lambda b, h, qi, ki: (b, h, qi, 0)
+    kv_map = lambda b, h, qi, ki: (b, h // groups, ki, 0)
+    row_map = lambda b, h, qi, ki: (b, h, qi, 0)
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        ),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), q_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+            pl.BlockSpec((1, 1, block_q, _ROW_LANES), row_map),
+            pl.BlockSpec((1, 1, block_q, D), q_map),
+            pl.BlockSpec((1, 1, block_q, _ROW_LANES), row_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), q_map),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, lse, do, delta)
+
+    # dk/dv: grid inner dim flattens (group member, q block) so the scratch
+    # accumulator sums the whole GQA group for this kv head
+    inner = groups * nq
+    g_q_map = lambda b, kv, ki, i: (b, kv * groups + i // nq, i % nq, 0)
+    g_row_map = lambda b, kv, ki, i: (b, kv * groups + i // nq, i % nq, 0)
+    g_kv_map = lambda b, kv, ki, i: (b, kv, ki, 0)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q_blocks=nq,
+            inner_steps=inner,
+        ),
+        grid=(B, KV, nk, inner),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), g_q_map),
+            pl.BlockSpec((1, 1, block_k, D), g_kv_map),
+            pl.BlockSpec((1, 1, block_k, D), g_kv_map),
+            pl.BlockSpec((1, 1, block_q, _ROW_LANES), g_row_map),
+            pl.BlockSpec((1, 1, block_q, D), g_q_map),
+            pl.BlockSpec((1, 1, block_q, _ROW_LANES), g_row_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), g_kv_map),
+            pl.BlockSpec((1, 1, block_k, D), g_kv_map),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, lse, do, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry (custom_vjp over heads-major layout)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_hm(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_hm_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_hm_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, do)
+
+
+_flash_hm.defvjp(_flash_hm_fwd, _flash_hm_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused differentiable attention in the model's native layout.
+
+    q: [B, S, H, D]; k/v: [B, S, KV, D] with H % KV == 0 (GQA, un-repeated).
+    Returns [B, S, H, D].  S must be divisible by the block sizes (the
+    Llama dispatch falls back to the naive path otherwise).
+    """
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    if H % KV:
+        raise ValueError(f"GQA needs H % KV == 0, got H={H} KV={KV}")
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(f"S={S} not divisible by blocks ({block_q},{block_k})")
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+
+    # kernel layout: heads-major so a (bq, D) block is contiguous in S,D
+    out = _flash_hm(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        float(sm_scale),
+        causal,
+        block_q,
+        block_k,
+        interpret,
+    )
+    return out.transpose(0, 2, 1, 3)
