@@ -199,12 +199,29 @@ class Llama:
             return False
         if seq < 128 or seq % min(512, seq):
             return False
+        if getattr(self, "_disable_flash", False):
+            return False
         if env == "1":
             return True
-        # auto: only for single-device programs — a pallas_call is not
-        # SPMD-partitionable, so inside a tp/fsdp-sharded jit it would force
-        # operand replication (use TORCHFT_FLASH=1 + shard_map to override)
-        return jax.default_backend() == "tpu" and jax.device_count() == 1
+        # auto: single-device programs use the bare kernel; multi-device
+        # needs a mesh for the shard_map variant (a bare pallas_call is not
+        # SPMD-partitionable — inside a tp/fsdp-sharded jit it would force
+        # operand replication)
+        if jax.default_backend() != "tpu":
+            return False
+        return jax.device_count() == 1 or self._flash_mesh() is not None
+
+    def _flash_mesh(self) -> Optional[Any]:
+        """The mesh for ``flash_attention_sharded``, if attention under it
+        is purely (batch, head)-parallel: dp/tp axes present, sp/ep/pp all
+        size 1 (those paths carry their own attention plumbing)."""
+        mesh = self.mesh
+        if mesh is None or "dp" not in mesh.shape or "tp" not in mesh.shape:
+            return None
+        for axis in ("sp", "ep", "pp"):
+            if mesh.shape.get(axis, 1) != 1:
+                return None
+        return mesh
 
     def _attention(
         self,
@@ -217,13 +234,28 @@ class Llama:
         cfg = self.config
 
         if self._use_flash(q.shape[1]):
-            from torchft_tpu.ops.flash_attention import flash_attention
-
-            return flash_attention(
-                q, k, v,
-                causal=True,
-                interpret=jax.default_backend() != "tpu",
+            from torchft_tpu.ops.flash_attention import (
+                flash_attention,
+                flash_attention_sharded,
             )
+
+            interpret = jax.default_backend() != "tpu"
+            mesh = self._flash_mesh()
+            B, _, H, _ = q.shape
+            if (
+                mesh is not None
+                and B % mesh.shape["dp"] == 0
+                and H % mesh.shape["tp"] == 0
+                and cfg.n_kv_heads % mesh.shape["tp"] == 0
+            ):
+                return flash_attention_sharded(
+                    q, k, v, mesh=mesh, causal=True, interpret=interpret
+                )
+            if jax.device_count() == 1 or mesh is None:
+                return flash_attention(
+                    q, k, v, causal=True, interpret=interpret
+                )
+            # mesh present but shapes don't shard evenly: naive path below
 
         groups = cfg.n_heads // cfg.n_kv_heads
         k = jnp.repeat(k, groups, axis=2)

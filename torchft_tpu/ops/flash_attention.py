@@ -419,3 +419,71 @@ def flash_attention(
         interpret,
     )
     return out.transpose(0, 2, 1, 3)
+
+
+def flash_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh,
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention under an SPMD mesh: batch over ``dp``, heads over
+    ``tp``.
+
+    A bare ``pallas_call`` is not SPMD-partitionable, so inside a sharded
+    jit it would force operand replication; attention is embarrassingly
+    parallel over (batch, head), so a shard_map manual over the whole mesh
+    with specs ``P(dp, None, tp, None)`` runs the kernel on local blocks
+    with zero communication.  Activations are replicated over ``fsdp``
+    (exactly like the XLA naive path); ``sp``/``pp``/``ep`` paths have
+    their own attention plumbing and must not route here.
+
+    Requires B % dp == 0, H % tp == 0, KV % tp == 0 (so each shard keeps
+    the full GQA group ratio).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    try:  # jax >= 0.8 top-level export, fall back to experimental
+        from jax import shard_map as _smap  # type: ignore[attr-defined]
+
+        _check_kw = {"check_vma": False}
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map as _smap
+
+        _check_kw = {"check_rep": False}  # pre-0.8 keyword
+
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    dp = mesh.shape[dp_axis]
+    tp = mesh.shape[tp_axis]
+    if B % dp or H % tp or KV % tp:
+        raise ValueError(
+            f"flash_attention_sharded needs B%dp==0, H%tp==0, KV%tp==0; "
+            f"got B={B} H={H} KV={KV} over dp={dp} tp={tp}"
+        )
+
+    spec = P(dp_axis, None, tp_axis, None)
+    body = functools.partial(
+        flash_attention,
+        causal=causal,
+        sm_scale=sm_scale,  # None → flash_attention derives 1/sqrt(D)
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    fn = _smap(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        **_check_kw,
+    )
+    return fn(q, k, v)

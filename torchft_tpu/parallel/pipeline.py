@@ -166,6 +166,10 @@ class PipelinedLlama(Llama):
         if config.sp_axis is not None:
             raise ValueError("pp x sp is unsupported (see docstring)")
         super().__init__(config, mesh)
+        # flash dispatch is disabled inside the pipeline's manual region:
+        # nesting the sharded variant's shard_map (or a bare pallas_call
+        # over auto-sharded dp/tp operands) inside it is unsupported
+        self._disable_flash = True
         self.pp_axis = pp_axis
         self.num_stages = mesh.shape[pp_axis]
         if config.n_layers % self.num_stages:
