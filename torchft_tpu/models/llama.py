@@ -242,18 +242,19 @@ class Llama:
             interpret = jax.default_backend() != "tpu"
             mesh = self._flash_mesh()
             B, _, H, _ = q.shape
+            if jax.device_count() == 1 or mesh is None:
+                # bare kernel: single-device programs, or forced via env
+                # without a mesh (then operands replicate — caller's call)
+                return flash_attention(
+                    q, k, v, causal=True, interpret=interpret
+                )
             if (
-                mesh is not None
-                and B % mesh.shape["dp"] == 0
+                B % mesh.shape["dp"] == 0
                 and H % mesh.shape["tp"] == 0
                 and cfg.n_kv_heads % mesh.shape["tp"] == 0
             ):
                 return flash_attention_sharded(
                     q, k, v, mesh=mesh, causal=True, interpret=interpret
-                )
-            if jax.device_count() == 1 or mesh is None:
-                return flash_attention(
-                    q, k, v, causal=True, interpret=interpret
                 )
             # mesh present but shapes don't shard evenly: naive path below
 

@@ -40,12 +40,14 @@ def fsdp_shardings(
     """(param shardings, batch shardings) for a model exposing
     ``param_specs()`` / ``batch_specs()`` (e.g. :class:`models.llama.Llama`).
 
-    Also attaches ``mesh`` to a bare model: every HSDP entry point
-    (``shard_init``/``make_grad_step``/``HSDPTrainer``) funnels through
-    here, and the model's attention needs the mesh to dispatch the
-    shard_map flash variant instead of silently taking the naive path."""
-    if getattr(model, "mesh", None) is None:
-        model.mesh = mesh
+    Also attaches ``mesh`` to the model (last call wins): every HSDP entry
+    point (``shard_init``/``make_grad_step``/``HSDPTrainer``) funnels
+    through here, and the model's attention needs the mesh to dispatch the
+    shard_map flash variant instead of silently taking the naive path.
+    Consequence: one model object serves one mesh at a time — rebuild (or
+    re-enter through this function) when the mesh changes, and don't drive
+    a shared model over two meshes concurrently."""
+    model.mesh = mesh
     param_specs = model.param_specs()
     params_sh = jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec),
