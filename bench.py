@@ -560,8 +560,11 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
     lighthouse.shutdown()
 
     # achieved model FLOPs: the standard 6N per token for the train step
-    # (fwd+bwd) plus the attention score/value matmuls 12·L·dim·S
-    flops_per_token = 6 * model.num_params() + 12 * sizes["layers"] * sizes[
+    # (fwd+bwd) plus the attention score/value matmuls 12·L·dim·S.  N
+    # excludes the embedding table (a gather, not a matmul — PaLM MFU
+    # convention) but keeps the lm_head projection, which is a real matmul
+    matmul_params = model.num_params() - config.vocab_size * config.dim
+    flops_per_token = 6 * matmul_params + 12 * sizes["layers"] * sizes[
         "dim"
     ] * sizes["seq"]
     tflops = ft_tps * flops_per_token / 1e12

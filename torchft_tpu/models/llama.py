@@ -263,8 +263,15 @@ class Llama:
         v = jnp.repeat(v, groups, axis=2)
 
         if cfg.sp_axis is not None:
-            from torchft_tpu.parallel.ring_attention import ring_attention_sharded
+            from torchft_tpu.parallel.ring_attention import (
+                ring_attention,
+                ring_attention_sharded,
+            )
 
+            if getattr(self, "_in_manual_sp", False):
+                # already inside a manual region over sp (the pp × sp
+                # pipeline): use the raw collective form
+                return ring_attention(q, k, v, cfg.sp_axis)
             assert self.mesh is not None, "sp requires a mesh on the model"
             return ring_attention_sharded(
                 q, k, v, mesh=self.mesh, sp_axis=cfg.sp_axis

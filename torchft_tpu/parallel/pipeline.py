@@ -102,6 +102,7 @@ def pipeline_spmd(
     axis: str = "pp",
     num_microbatches: int,
     remat: bool = False,
+    sp_axis: Optional[str] = None,
 ) -> jax.Array:
     """Run ``x`` [B, S, D] through a layer stack pipelined over ``axis``.
 
@@ -111,6 +112,11 @@ def pipeline_spmd(
     [mb, S, D].  ``remat=True`` wraps the stage in ``jax.checkpoint`` so the
     backward pipeline recomputes stage activations instead of saving one per
     tick (GPipe's activation-memory trade, via XLA rematerialization).
+
+    ``sp_axis``: compose with sequence parallelism — the shard_map goes
+    manual over {pp, sp}, activations shard their seq dim over ``sp``, and
+    ``stage_fn`` sees seq-local blocks (its attention must use the ring
+    collective form over ``sp``; positions need the sp-block offset).
     """
     num_stages = mesh.shape[axis]
     B = x.shape[0]
@@ -126,12 +132,16 @@ def pipeline_spmd(
         num_stages=num_stages,
         num_microbatches=num_microbatches,
     )
+    manual = frozenset({axis, sp_axis} if sp_axis else {axis})
+    # x_mb is [M, mb, S, D]: seq (dim 2) shards over sp inside the manual
+    # region; everything else about the schedule is sp-oblivious
+    x_spec = P(None, None, sp_axis, None) if sp_axis else P()
     out_mb = _shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
-        axis_names=frozenset({axis}),
+        in_specs=(P(axis), x_spec),
+        out_specs=x_spec,
+        axis_names=manual,
         check_vma=False,
     )(stacked_params, x_mb)
     return out_mb.reshape(B, *x.shape[1:])
@@ -149,10 +159,14 @@ class PipelinedLlama(Llama):
     and each stage materializes only its own layers — PP here is *free* at
     the parameter-layout level, composing with FSDP/TP on the other dims.
 
+    pp × sp composes: with ``config.sp_axis`` set, the pipeline's
+    shard_map goes manual over {pp, sp}, activations shard their sequence
+    dim over ``sp``, and each stage's attention runs the ring collective
+    form directly (it is built for callers already inside a manual
+    region), with RoPE positions offset by the sp block index.
+
     Constraints: ``n_layers % pp == 0``; batch divisible by
-    ``num_microbatches``; ``sp_axis`` unsupported (ring attention's own
-    shard_map can't nest inside the pipeline's manual region — compose
-    pp with dp/fsdp/tp, or use sp without pp).
+    ``num_microbatches``; seq divisible by the ``sp`` size when composed.
     """
 
     def __init__(
@@ -163,9 +177,10 @@ class PipelinedLlama(Llama):
         num_microbatches: Optional[int] = None,
         remat: bool = False,
     ) -> None:
-        if config.sp_axis is not None:
-            raise ValueError("pp x sp is unsupported (see docstring)")
         super().__init__(config, mesh)
+        # ring attention must use its raw collective form inside the
+        # pipeline's manual region (its own shard_map cannot nest)
+        self._in_manual_sp = config.sp_axis is not None
         # flash dispatch is disabled inside the pipeline's manual region:
         # nesting the sharded variant's shard_map (or a bare pallas_call
         # over auto-sharded dp/tp operands) inside it is unsupported
@@ -190,9 +205,16 @@ class PipelinedLlama(Llama):
         return specs
 
     def _stage_fn(self, stage_layers: Dict[str, jax.Array], h: jax.Array):
-        """Apply this stage's layer slice to local activations [mb, S, D]."""
+        """Apply this stage's layer slice to local activations [mb, S, D].
+        Under pp × sp, S is the sp-local block and RoPE positions carry
+        the block's global offset."""
         B, S, _ = h.shape
-        positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        offset = (
+            jax.lax.axis_index(self.config.sp_axis) * S
+            if self.config.sp_axis is not None
+            else 0
+        )
+        positions = offset + jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
         rope = self._rope(positions)
 
         def scan_body(carry, layer_params):
@@ -212,6 +234,7 @@ class PipelinedLlama(Llama):
             axis=self.pp_axis,
             num_microbatches=self.num_microbatches,
             remat=self.remat,
+            sp_axis=cfg.sp_axis,
         )
         x = self._rms_norm(x, params["final_norm"], cfg.norm_eps)
         return (x @ params["lm_head"]).astype(jnp.float32)
