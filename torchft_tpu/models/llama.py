@@ -258,11 +258,9 @@ class Llama:
                 )
             # mesh present but shapes don't shard evenly: naive path below
 
-        groups = cfg.n_heads // cfg.n_kv_heads
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
-
         if cfg.sp_axis is not None:
+            # the ring ships GQA K/V un-repeated (group-factor fewer
+            # ppermute bytes); the body broadcasts at compute time
             from torchft_tpu.parallel.ring_attention import (
                 ring_attention,
                 ring_attention_sharded,
@@ -276,6 +274,10 @@ class Llama:
             return ring_attention_sharded(
                 q, k, v, mesh=self.mesh, sp_axis=cfg.sp_axis
             )
+
+        groups = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
 
         scale = 1.0 / np.sqrt(cfg.head_dim)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale

@@ -128,11 +128,13 @@ def _fwd(
     block_k: int,
     interpret: bool,
 ) -> Tuple[jax.Array, jax.Array]:
-    """q [B,H,S,D], k/v [B,KV,S,D] → (o [B,H,S,D], lse [B,H,S])."""
+    """q [B,H,Sq,D], k/v [B,KV,Sk,D] → (o [B,H,Sq,D], lse [B,H,Sq]).
+    Rectangular (Sq != Sk) is allowed when not causal."""
     B, H, S, D = q.shape
     KV = k.shape[1]
+    Sk = k.shape[2]
     groups = H // KV
-    nq, nk = S // block_q, S // block_k
+    nq, nk = S // block_q, Sk // block_k
     kernel = functools.partial(
         _fwd_kernel,
         sm_scale=sm_scale,
@@ -278,22 +280,26 @@ def _dkv_kernel(
 
 
 def _bwd(
-    sm_scale, causal, block_q, block_k, interpret, residuals, do
+    sm_scale, causal, block_q, block_k, interpret, residuals, do, dlse=None
 ):
+    """``dlse`` (optional, [B, H, S]): cotangent of the logsumexp output.
+    Since ∂lse_i/∂s_ij = p_ij, it folds into the existing delta term:
+    ds = p·(dp − (delta − dlse)) — the kernels are unchanged."""
     q, k, v, o, lse = residuals
     B, H, S, D = q.shape
     KV = k.shape[1]
+    Sk = k.shape[2]
     groups = H // KV
-    nq, nk = S // block_q, S // block_k
+    nq, nk = S // block_q, Sk // block_k
 
-    delta = jnp.broadcast_to(
-        jnp.sum(
-            do.astype(jnp.float32) * o.astype(jnp.float32),
-            axis=-1,
-            keepdims=True,
-        ),
-        (B, H, S, _ROW_LANES),
+    delta_rows = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32),
+        axis=-1,
+        keepdims=True,
     )
+    if dlse is not None:
+        delta_rows = delta_rows - dlse[..., None].astype(jnp.float32)
+    delta = jnp.broadcast_to(delta_rows, (B, H, S, _ROW_LANES))
 
     q_map = lambda b, h, qi, ki: (b, h, qi, 0)
     kv_map = lambda b, h, qi, ki: (b, h // groups, ki, 0)
@@ -361,6 +367,29 @@ def _bwd(
 # ---------------------------------------------------------------------------
 
 
+def _validate(q, k, causal, sm_scale, block_q, block_k):
+    """Shared shape/divisibility validation for the public wrappers
+    ([B, S, H, D] layout).  Returns the resolved (sm_scale, bq, bk)."""
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    Sk = k.shape[1]
+    if H % KV:
+        raise ValueError(f"GQA needs H % KV == 0, got H={H} KV={KV}")
+    if causal and Sk != S:
+        raise ValueError(
+            f"causal attention needs Sq == Sk, got Sq={S} Sk={Sk}"
+        )
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    if S % block_q or Sk % block_k:
+        raise ValueError(
+            f"Sq={S}/Sk={Sk} not divisible by blocks ({block_q},{block_k})"
+        )
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+    return float(sm_scale), block_q, block_k
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_hm(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
@@ -377,6 +406,64 @@ def _flash_hm_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
 
 
 _flash_hm.defvjp(_flash_hm_fwd, _flash_hm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_hm_lse(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    """Heads-major flash returning (o, lse [B,H,S] f32) — for callers that
+    merge partial attention results across blocks (ring attention)."""
+    o, lse4 = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o, lse4[..., 0]
+
+
+def _flash_hm_lse_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, lse4 = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return (o, lse4[..., 0]), (q, k, v, o, lse4)
+
+
+def _flash_hm_lse_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
+    do, dlse = cts
+    return _bwd(
+        sm_scale, causal, block_q, block_k, interpret, res, do, dlse=dlse
+    )
+
+
+_flash_hm_lse.defvjp(_flash_hm_lse_fwd, _flash_hm_lse_bwd)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Like :func:`flash_attention` but also returns the rowwise logsumexp
+    (``[B, S, H]``, f32), so partial results over different K/V blocks can
+    be merged exactly: ``lse = logaddexp(lse1, lse2)``,
+    ``o = o1·exp(lse1−lse) + o2·exp(lse2−lse)``.  Differentiable in both
+    outputs (the lse cotangent folds into the backward delta term).
+
+    K/V may carry a different sequence length than q (partial-block
+    attention) when ``causal=False``."""
+    sm_scale, block_q, block_k = _validate(
+        q, k, causal, sm_scale, block_q, block_k
+    )
+    o, lse = _flash_hm_lse(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        float(sm_scale),
+        causal,
+        block_q,
+        block_k,
+        interpret,
+    )
+    return o.transpose(0, 2, 1, 3), lse.transpose(0, 2, 1)
 
 
 def flash_attention(
@@ -396,16 +483,9 @@ def flash_attention(
     Returns [B, S, H, D].  S must be divisible by the block sizes (the
     Llama dispatch falls back to the naive path otherwise).
     """
-    B, S, H, D = q.shape
-    KV = k.shape[2]
-    if H % KV:
-        raise ValueError(f"GQA needs H % KV == 0, got H={H} KV={KV}")
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
-    if S % block_q or S % block_k:
-        raise ValueError(f"S={S} not divisible by blocks ({block_q},{block_k})")
-    if sm_scale is None:
-        sm_scale = 1.0 / float(np.sqrt(D))
+    sm_scale, block_q, block_k = _validate(
+        q, k, causal, sm_scale, block_q, block_k
+    )
 
     # kernel layout: heads-major so a (bq, D) block is contiguous in S,D
     out = _flash_hm(

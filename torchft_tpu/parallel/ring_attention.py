@@ -16,7 +16,9 @@ diagonal block is masked triangularly, earlier blocks attend fully.
 
 from __future__ import annotations
 
+import os
 from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -34,7 +36,10 @@ def _ring_attention_local(
     v: jax.Array,
     axis_name: str,
 ) -> jax.Array:
-    """shard_map body: q/k/v are LOCAL blocks [B, S_blk, H, D].
+    """shard_map body: q is a LOCAL block [B, S_blk, H, D]; k/v are LOCAL
+    blocks [B, S_blk, KV, D] with H % KV == 0 (GQA **un-repeated** — the
+    ring ships the grouped K/V and broadcasts to full heads only at
+    compute time, cutting ppermute bytes by the group factor).
 
     Online softmax across ring steps (numerically stable streaming
     accumulation); one ppermute per step rotates the K/V block to the next
@@ -43,7 +48,20 @@ def _ring_attention_local(
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
+    groups = H // k.shape[2]
     scale = 1.0 / np.sqrt(D)
+
+    # per-block flash: the Pallas kernel replaces the einsum-softmax block
+    # math when block shapes qualify (trace-time decision; TORCHFT_FLASH
+    # env forces/kills, interpret off-TPU)
+    env = os.environ.get("TORCHFT_FLASH", "")
+    if (
+        env != "0"
+        and S >= 128
+        and S % min(512, S) == 0
+        and (env == "1" or jax.default_backend() == "tpu")
+    ):
+        return _ring_attention_flash(q, k, v, axis_name, n, my_idx)
 
     q32 = q.astype(jnp.float32)
     # accumulators: running output (unnormalized), row max, denominator
@@ -59,8 +77,11 @@ def _ring_attention_local(
         o, m, l, k_blk, v_blk = carry
         src_idx = (my_idx - step_idx) % n  # whose block we hold this step
 
+        # broadcast the grouped K/V block to full heads at compute time
+        k_full = jnp.repeat(k_blk, groups, axis=2)
+        v_full = jnp.repeat(v_blk, groups, axis=2)
         scores = (
-            jnp.einsum("bqhd,bkhd->bqhk", q32, k_blk.astype(jnp.float32))
+            jnp.einsum("bqhd,bkhd->bqhk", q32, k_full.astype(jnp.float32))
             * scale
         )
         # causal mask from global block indices:
@@ -81,7 +102,7 @@ def _ring_attention_local(
         p = jnp.exp(scores - m_new[..., None])  # [B,S,H,K]
         l_new = l * correction + jnp.sum(p, axis=-1)
         o_new = o * correction[..., None] + jnp.einsum(
-            "bqhk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+            "bqhk,bkhd->bqhd", p, v_full.astype(jnp.float32)
         )
 
         # rotate K/V to the next rank (ring over ICI)
@@ -98,6 +119,74 @@ def _ring_attention_local(
     return (o / denom[..., None]).astype(q.dtype)
 
 
+def _ring_attention_flash(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    n: int,
+    my_idx: jax.Array,
+) -> jax.Array:
+    """Ring attention with the fused Pallas kernel as the per-block math.
+
+    Each ring step runs :func:`flash_attention_lse` on the held K/V block
+    (causal for the diagonal block, unmasked for earlier blocks, skipped
+    for later ones — the same block relationship the einsum path masks
+    with) and merges the normalized partials exactly via logsumexp:
+    ``lse' = logaddexp(lse, lse_b)``,
+    ``o' = o·exp(lse−lse') + o_b·exp(lse_b−lse')``.
+
+    Step 0 is always the diagonal block, so ``lse`` is finite from the
+    first merge and the −inf initializations never meet each other.
+    """
+    from torchft_tpu.ops.flash_attention import flash_attention_lse
+
+    interpret = jax.default_backend() != "tpu"
+    B, S, H, D = q.shape
+
+    def _block(causal):
+        def run(k_blk, v_blk):
+            return flash_attention_lse(
+                q, k_blk, v_blk, causal=causal, interpret=interpret
+            )
+
+        return run
+
+    diag, full = _block(True), _block(False)
+
+    def skip(k_blk, v_blk):
+        return (
+            jnp.zeros((B, S, H, D), q.dtype),
+            jnp.full((B, S, H), -jnp.inf, jnp.float32),
+        )
+
+    o0 = jnp.zeros((B, S, H, D), jnp.float32)
+    lse0 = jnp.full((B, S, H), -jnp.inf, jnp.float32)
+
+    def step(carry, step_idx):
+        o, lse, k_blk, v_blk = carry
+        src_idx = (my_idx - step_idx) % n
+        o_b, lse_b = jax.lax.cond(
+            src_idx == my_idx,
+            diag,
+            lambda kb, vb: jax.lax.cond(src_idx < my_idx, full, skip, kb, vb),
+            k_blk,
+            v_blk,
+        )
+        lse_new = jnp.logaddexp(lse, lse_b)
+        w_old = jnp.exp(lse - lse_new)
+        w_new = jnp.exp(lse_b - lse_new)
+        o = o * w_old[..., None] + o_b.astype(jnp.float32) * w_new[..., None]
+
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o, lse_new, k_next, v_next), None
+
+    (o, _, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype)
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,
@@ -107,8 +196,9 @@ def ring_attention_sharded(
 ) -> jax.Array:
     """Ring attention entry point for jit-traced (global-shape) arrays.
 
-    q/k/v: [B, S, H, D] with S sharded over ``sp_axis``, B over ``dp``, and
-    heads over ``tp``; returns attention output with the same layout.
+    q: [B, S, H, D]; k/v: [B, S, KV, D] un-repeated (H % KV == 0), with S
+    sharded over ``sp_axis``, B over ``dp``, and heads over ``tp``;
+    returns attention output in q's layout.
     """
     spec = P("dp", sp_axis, "tp", None)
     fn = _shard_map(
