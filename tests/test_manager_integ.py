@@ -679,7 +679,9 @@ def test_protocol_overhead_stays_hot(lighthouse) -> None:
         # median, not mean: robust to scheduler stalls when the suite loads
         # the shared box — the regression this guards (a reconnect or
         # reconfigure on every step) shifts the whole distribution
+        # 50 ms: loose enough for an oversubscribed shared CI box, still
+        # clearly below the ~100 ms/step cold-path regression this guards
         per_step = sorted(times)[steps // 2]
-        assert per_step < 0.020, f"protocol {per_step*1e3:.1f} ms/step (cold path?)"
+        assert per_step < 0.050, f"protocol {per_step*1e3:.1f} ms/step (cold path?)"
     finally:
         manager.shutdown()

@@ -29,6 +29,7 @@ import logging
 import os
 import socket
 import threading
+import time
 import uuid
 from enum import Enum
 from typing import Callable, Dict, List, Optional, TypeVar, Union, cast
@@ -176,6 +177,8 @@ class Manager:
         self._commit_failures = 0
         self._quorum_id = -1
         self._quorum_future: Optional[concurrent.futures.Future] = None
+        # phase wall-times of the most recent quorum round (see _async_quorum)
+        self.last_quorum_timings: Dict[str, float] = {}
         self._participating_replica_rank: Optional[int] = None
         self._participating_replica_world_size: int = 0
 
@@ -359,7 +362,13 @@ class Manager:
         """Compute a new quorum and ready the manager for a new step
         (``manager.py:560-615``)."""
         if self._quorum_future is not None:
-            self._quorum_future.result()
+            try:
+                self._quorum_future.result()
+            except Exception:  # noqa: BLE001
+                # already funneled (or about to be superseded): the failed
+                # step was voted down at should_commit; the retry starting
+                # here must not re-raise the same error into the train loop
+                pass
 
         self._errored = None
         self._healing = False
@@ -393,6 +402,12 @@ class Manager:
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
+        # per-phase wall times of THIS quorum round, for heal attribution
+        # (bench/operators read it after wait_quorum; the reference leaves
+        # this to profiler spans — a dict is greppable in a kill report)
+        timings: Dict[str, float] = {}
+        self.last_quorum_timings = timings
+        t0 = time.monotonic()
         quorum = self._client._quorum(
             group_rank=self._group_rank,
             step=self._step,
@@ -402,6 +417,7 @@ class Manager:
             init_sync=self._init_sync,
             commit_failures=self._commit_failures,
         )
+        timings["quorum_rpc_s"] = time.monotonic() - t0
 
         quorum_id = quorum.quorum_id
         replica_rank = quorum.replica_rank
@@ -454,6 +470,7 @@ class Manager:
             )
             # fresh profiler epoch per quorum (flight-recorder analog)
             self._tracer.on_quorum_change(quorum_id)
+            t_cfg = time.monotonic()
             try:
                 self._quorum_id = quorum_id
                 self._comm.configure(
@@ -470,6 +487,8 @@ class Manager:
                 self._logger.exception(f"got exception in comm configure: {e}")
                 self.report_error(e)
                 return
+            finally:
+                timings["configure_s"] = time.monotonic() - t_cfg
 
         if allow_heal:
             # The reference runs recovery on a dedicated CUDA stream
@@ -481,14 +500,17 @@ class Manager:
                     self._logger.info(
                         f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
                     )
+                    t_send = time.monotonic()
                     self._checkpoint_transport.send_checkpoint(
                         dst_ranks=quorum.recover_dst_replica_ranks,
                         step=max_step,
                         state_dict=self._manager_state_dict(),
                         timeout=self._timeout,
                     )
+                    timings["heal_send_s"] = time.monotonic() - t_send
 
                 if heal:
+                    t_recv = time.monotonic()
                     self._healing = True
                     self._logger.info(
                         "healing required, fetching checkpoint metadata from "
@@ -522,6 +544,7 @@ class Manager:
                         cast(Dict[str, int], self._pending_state_dict["torchft"])
                     )
                     self._step = max_step
+                    timings["heal_recv_s"] = time.monotonic() - t_recv
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in recovery: {e}")
                 self.report_error(e)
@@ -702,8 +725,17 @@ class Manager:
         # the vote depends on this step's quorum results (participation
         # facts, healing state) — wait it even if no allreduce ran this step
         # (e.g. a protocol-only or fully-quantized step); otherwise the vote
-        # can read a stale participant count and spuriously fail
-        self.wait_quorum()
+        # can read a stale participant count and spuriously fail.  A quorum
+        # failure becomes a False vote (absorbed by the commit_failures /
+        # max_retries path), not an exception out of the train loop —
+        # calling without start_quorum at all is still a loud assert
+        assert self._quorum_future is not None, (
+            "must call start_quorum before should_commit"
+        )
+        try:
+            self.wait_quorum()
+        except Exception as e:  # noqa: BLE001 — funnel, never raise
+            self.report_error(e)
         # fence all in-flight collectives, then recovery, before voting
         self._fence_pending_works()
         if self._recovery_event is not None:

@@ -46,6 +46,12 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # sequence parallelism: ring attention over this mesh axis when set
     sp_axis: Optional[str] = None
+    # rematerialization: recompute each decoder layer in the backward pass,
+    # saving only the [B,S,dim] layer-boundary activations — trades ~1/3
+    # more FLOPs for O(layers) less activation HBM, which is what lets a
+    # ~1B-param config train on a single chip (the reference leans on
+    # torch's activation checkpointing via torchtitan for the same reason)
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -197,7 +203,9 @@ class Llama:
         env = os.environ.get("TORCHFT_FLASH", "")
         if env == "0":
             return False
-        if seq < 128 or seq % min(512, seq):
+        # seq % 8: Mosaic requires 8-divisible sublane dims — a 130-long seq
+        # in [128, 512) would otherwise pick block_q=seq and fail to lower
+        if seq < 128 or seq % 8 or seq % min(512, seq):
             return False
         if getattr(self, "_disable_flash", False):
             return False
@@ -329,6 +337,17 @@ class Llama:
 
         def scan_body(carry, layer_params):
             return self._layer(carry, layer_params, rope, positions), None
+
+        if cfg.remat:
+            # keep only the residual stream at layer boundaries; each layer
+            # recomputes in the backward pass
+            # prevent_cse is unnecessary under lax.scan (per jax docs) and
+            # its optimization barriers cost step time
+            scan_body = jax.checkpoint(
+                scan_body,
+                policy=jax.checkpoint_policies.nothing_saveable,
+                prevent_cse=False,
+            )
 
         x, _ = jax.lax.scan(scan_body, x, params["layers"])
         x = self._rms_norm(x, params["final_norm"], cfg.norm_eps)

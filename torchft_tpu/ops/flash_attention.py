@@ -531,14 +531,7 @@ def flash_attention_sharded(
     """
     from jax.sharding import PartitionSpec as P
 
-    try:  # jax >= 0.8 top-level export, fall back to experimental
-        from jax import shard_map as _smap  # type: ignore[attr-defined]
-
-        _check_kw = {"check_vma": False}
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map as _smap
-
-        _check_kw = {"check_rep": False}  # pre-0.8 keyword
+    from torchft_tpu.parallel._compat import shard_map as _smap
 
     B, S, H, D = q.shape
     KV = k.shape[2]
@@ -564,6 +557,5 @@ def flash_attention_sharded(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        **_check_kw,
     )
     return fn(q, k, v)

@@ -1,0 +1,47 @@
+"""shard_map across jax API generations, in one place.
+
+jax >= 0.8 exports ``jax.shard_map`` taking the *manual* axes
+(``axis_names``) and ``check_vma``; the pre-0.8 experimental API takes the
+complement (``auto``) and calls the check ``check_rep``.  Every shard_map
+call site in the package routes through :func:`shard_map` so the
+translation lives at one altitude.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+try:
+    from jax import shard_map as _impl  # type: ignore[attr-defined]
+
+    _NEW_API = True
+except ImportError:  # pragma: no cover — jax < 0.8
+    from jax.experimental.shard_map import shard_map as _impl
+
+    _NEW_API = False
+
+
+def shard_map(
+    body: Any,
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    axis_names: Optional[frozenset] = None,
+    check_vma: bool = False,
+) -> Any:
+    """``axis_names=None`` means manual over every mesh axis (the common
+    case); a frozenset makes only those axes manual."""
+    if _NEW_API:
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return _impl(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    kw = {"check_rep": check_vma}  # pragma: no cover — jax < 0.8
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _impl(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )

@@ -34,10 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from torchft_tpu.models.llama import Llama, LlamaConfig
 
-try:  # jax >= 0.8 top-level export, fall back to experimental
-    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from torchft_tpu.parallel._compat import shard_map as _shard_map
 
 
 def _pipeline_local(

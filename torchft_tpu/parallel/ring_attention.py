@@ -24,10 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8 top-level export, fall back to experimental
-    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from torchft_tpu.parallel._compat import shard_map as _shard_map
 
 
 def _ring_attention_local(
@@ -58,6 +55,7 @@ def _ring_attention_local(
     if (
         env != "0"
         and S >= 128
+        and S % 8 == 0  # Mosaic sublane-divisibility, same gate as _use_flash
         and S % min(512, S) == 0
         and (env == "1" or jax.default_backend() == "tpu")
     ):
