@@ -1,31 +1,44 @@
 """Benchmark: the north-star measurement (BASELINE.json).
 
-Three phases, all on the same backend (TPU when the tunnel is healthy):
+Four phases, all on the same backend (TPU when the tunnel is healthy):
 
-A. **ws=1 overhead** — tokens/sec/chip for a plain jitted train loop vs the
-   full fault-tolerant stack (lighthouse + manager + per-step quorum/commit
-   RPCs) in one process.  Gives the absolute tokens/sec/chip number and the
-   protocol-overhead ratio.
-B. **fault-free fleet** — 2 replica-group subprocesses, each a real
-   TCPCommunicator + Manager + HTTP-heal stack doing replica-dim gradient
-   averaging over the DCN ring, no failures.  Survivor steps/sec is the
-   fault-free fleet baseline.
-C. **fleet under faults** — same fleet, but replica 1 is SIGKILLed every K
-   survivor steps and auto-respawned (torchft_tpu.launcher supervision); the
-   rejoining process heals live weights from the survivor.  Reports the
-   with-faults/fault-free throughput ratio (the BASELINE ≥0.95 target) and
-   the mean heal-in steps (survivor steps from kill to the victim's first
-   committed step back in quorum) — the reference measures the same two
-   quantities in its manager integration harness
-   (``torchft/manager_integ_test.py:340-430``).
+A. **ws=1 overhead + MFU** — tokens/sec/chip for a plain jitted train loop
+   vs the full fault-tolerant stack (lighthouse + manager + per-step
+   quorum/commit RPCs) in one process, on a ~0.8B-param remat'd Llama.
+   Reports absolute tokens/sec/chip, model TFLOP/s, and MFU against the
+   chip's autodetected bf16 peak.
+B. **fault-free fleet** — N replica-group subprocesses (default 3 on TPU),
+   each a real Communicator + Manager + HTTP-heal stack doing replica-dim
+   gradient averaging over the DCN ring, no failures.
+C. **fleet under faults** — same fleet, but victims (rotating over replicas
+   1..N-1; replica 0 is the measurement anchor) are SIGKILLed every K
+   survivor steps and auto-respawned; each rejoining process heals live
+   weights from a survivor.  Reports the with-faults/fault-free throughput
+   ratio (the BASELINE >=0.95 target), mean heal-in seconds, and a
+   per-phase **heal breakdown** (respawn / jax init / model build / join+
+   rendezvous+transfer / first-step compile) from worker-side phase logs.
+   The reference measures the same quantities in its manager integration
+   harness (``torchft/manager_integ_test.py:340-430``).
+D. **DiLoCo under churn** (BASELINE config 4) — N islands running
+   Streaming DiLoCo (fragments, sync_every, τ delay) with kills timed to
+   land inside the fragment-sync window; reports inner-step throughput
+   ratio vs a fault-free DiLoCo fleet and the per-sync overhead
+   (``torchft/local_sgd.py:175-795``).
+
+The whole bench runs on the production tier by default: C++ lighthouse +
+manager servers and the C++ data-plane communicator when
+``native/libtpuft.so`` loads, Python otherwise (``"tier"`` in the output
+records which; the reference likewise benches NCCL, not Gloo).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 ``value`` is the phase-C/phase-B ratio when the fleet phases complete, else
 the phase-A ratio (and "faults" reports why).
 
 Env knobs: TPUFT_BENCH_STEPS, TPUFT_BENCH_DIM, TPUFT_BENCH_LAYERS,
-TPUFT_BENCH_SEQ, TPUFT_BENCH_BATCH, TPUFT_BENCH_PLATFORM,
-TPUFT_BENCH_FLEET_STEPS, TPUFT_BENCH_KILL_EVERY, TPUFT_BENCH_SKIP_FLEET.
+TPUFT_BENCH_SEQ, TPUFT_BENCH_BATCH, TPUFT_BENCH_HEAD_DIM,
+TPUFT_BENCH_REMAT, TPUFT_BENCH_PLATFORM, TPUFT_BENCH_FLEET_STEPS,
+TPUFT_BENCH_KILL_EVERY, TPUFT_BENCH_REPLICAS, TPUFT_BENCH_SKIP_FLEET,
+TPUFT_BENCH_SKIP_DILOCO, TPUFT_PEAK_TFLOPS, TORCHFT_TIER.
 """
 
 from __future__ import annotations
@@ -43,6 +56,32 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 CACHE_DIR = os.path.join(REPO, ".jax_cache")
+
+# per-chip bf16 peak TFLOP/s by device_kind substring (first match wins;
+# "lite" variants must precede the bare generation string)
+_TPU_PEAKS: List[Tuple[str, float]] = [
+    ("v6e", 918.0),
+    ("v6 lite", 918.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v5litepod", 197.0),
+    ("v5p", 459.0),
+    ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 46.0),
+]
+
+
+def _peak_tflops(device) -> Optional[float]:
+    env = os.environ.get("TPUFT_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for pat, peak in _TPU_PEAKS:
+        if pat in kind:
+            return peak
+    return None
 
 
 def _probe_backend(timeout_s: float = 180.0) -> bool:
@@ -73,40 +112,38 @@ def _configure_jax(platform: Optional[str]) -> None:
 def _sizes(on_cpu: bool) -> Dict[str, int]:
     """Workload dims; CPU fallback shrinks so the ratio still gets measured
     in minutes rather than timing out the driver."""
+
+    def env_int(name: str, cpu: int, tpu: int) -> int:
+        return int(os.environ.get(name, cpu if on_cpu else tpu))
+
     return {
-        # phase A sizes a model big enough that a step is tens of ms (like
-        # the 8B target scaled to one chip) — against a ~3 ms toy step the
-        # fixed ~1 ms/step protocol RPC would read as a 20%+ tax that no
-        # real workload sees
-        # 40 steps amortize the one D2H sync RTT (~70 ms on the tunnel) to
-        # ~2% of the timed window
-        "steps": int(os.environ.get("TPUFT_BENCH_STEPS", 10 if on_cpu else 40)),
-        "dim": int(os.environ.get("TPUFT_BENCH_DIM", 256 if on_cpu else 768)),
-        "layers": int(os.environ.get("TPUFT_BENCH_LAYERS", 4 if on_cpu else 12)),
-        "seq": int(os.environ.get("TPUFT_BENCH_SEQ", 256 if on_cpu else 1024)),
-        "batch": int(os.environ.get("TPUFT_BENCH_BATCH", 4 if on_cpu else 8)),
-        "fleet_steps": int(
-            os.environ.get("TPUFT_BENCH_FLEET_STEPS", 16 if on_cpu else 90)
-        ),
-        "kill_every": int(
-            os.environ.get("TPUFT_BENCH_KILL_EVERY", 6 if on_cpu else 30)
-        ),
+        # phase A: a ~0.8B-param Llama (dim 2048 x 16 layers, head_dim 128,
+        # seq 2048) — big enough that MXU efficiency, not protocol RPC,
+        # decides the number; remat makes it fit single-chip HBM
+        "steps": env_int("TPUFT_BENCH_STEPS", 8, 30),
+        "dim": env_int("TPUFT_BENCH_DIM", 256, 2048),
+        "layers": env_int("TPUFT_BENCH_LAYERS", 4, 16),
+        "seq": env_int("TPUFT_BENCH_SEQ", 256, 2048),
+        "batch": env_int("TPUFT_BENCH_BATCH", 4, 8),
+        "head_dim": env_int("TPUFT_BENCH_HEAD_DIM", 64, 128),
+        "remat": env_int("TPUFT_BENCH_REMAT", 0, 1),
+        "fleet_steps": env_int("TPUFT_BENCH_FLEET_STEPS", 16, 100),
+        "kill_every": env_int("TPUFT_BENCH_KILL_EVERY", 6, 25),
+        "replicas": env_int("TPUFT_BENCH_REPLICAS", 2, 3),
         # fleet phases measure the FT mechanics (quorum, DCN ring, kill,
         # heal); a smaller model keeps per-step host<->device traffic sane —
-        # under the axon debug tunnel every D2H crosses a network link, so
-        # fleet grads are sized to keep a step in the seconds, not tens
-        "fleet_dim": int(
-            os.environ.get("TPUFT_BENCH_FLEET_DIM", 256 if on_cpu else 256)
-        ),
-        "fleet_layers": int(
-            os.environ.get("TPUFT_BENCH_FLEET_LAYERS", 4 if on_cpu else 4)
-        ),
-        "fleet_seq": int(
-            os.environ.get("TPUFT_BENCH_FLEET_SEQ", 256 if on_cpu else 512)
-        ),
-        "fleet_batch": int(
-            os.environ.get("TPUFT_BENCH_FLEET_BATCH", 4 if on_cpu else 8)
-        ),
+        # under the axon debug tunnel every D2H crosses a network link
+        "fleet_dim": env_int("TPUFT_BENCH_FLEET_DIM", 256, 256),
+        "fleet_layers": env_int("TPUFT_BENCH_FLEET_LAYERS", 4, 4),
+        "fleet_seq": env_int("TPUFT_BENCH_FLEET_SEQ", 256, 512),
+        "fleet_batch": env_int("TPUFT_BENCH_FLEET_BATCH", 4, 8),
+        "fleet_head_dim": 64,
+        # phase D (DiLoCo): inner steps + streaming-fragment schedule
+        "diloco_steps": env_int("TPUFT_BENCH_DILOCO_STEPS", 24, 80),
+        "diloco_sync_every": env_int("TPUFT_BENCH_DILOCO_SYNC", 8, 8),
+        "diloco_fragments": 2,
+        "diloco_sync_delay": 2,
+        "diloco_kills": env_int("TPUFT_BENCH_DILOCO_KILLS", 1, 2),
     }
 
 
@@ -122,20 +159,25 @@ def _sync(tree: Any) -> None:
     jax.device_get(leaf.ravel()[0])
 
 
-def _build_model(sizes: Dict[str, int]):
+def _build_model(sizes: Dict[str, int], fleet: bool = False):
     import jax.numpy as jnp
 
     from torchft_tpu.models.llama import Llama, LlamaConfig
 
+    prefix = "fleet_" if fleet else ""
+    dim = sizes[f"{prefix}dim"]
+    head_dim = sizes[f"{prefix}head_dim"]
+    n_heads = max(1, dim // head_dim)
     config = LlamaConfig(
         vocab_size=8192,
-        dim=sizes["dim"],
-        n_layers=sizes["layers"],
-        n_heads=max(1, sizes["dim"] // 64),
-        n_kv_heads=max(1, sizes["dim"] // 128),
-        ffn_hidden=sizes["dim"] * 3,
-        max_seq_len=sizes["seq"],
+        dim=dim,
+        n_layers=sizes[f"{prefix}layers"],
+        n_heads=n_heads,
+        n_kv_heads=max(1, n_heads // 4),
+        ffn_hidden=dim * 3,
+        max_seq_len=sizes[f"{prefix}seq"],
         dtype=jnp.bfloat16,
+        remat=bool(not fleet and sizes.get("remat")),
     )
     return Llama(config), config
 
@@ -145,99 +187,193 @@ def _build_model(sizes: Dict[str, int]):
 # --------------------------------------------------------------------------
 
 
+class _EventLog:
+    """Line-buffered JSONL event/phase log; survives SIGKILL mid-line (the
+    reader skips torn lines)."""
+
+    def __init__(self, path: str) -> None:
+        self._f = open(path, "a", buffering=1)
+
+    def phase(self, name: str, **extra: Any) -> None:
+        rec = {"phase": name, "ts": time.time()}
+        rec.update(extra)
+        self._f.write(json.dumps(rec) + "\n")
+
+    def step(self, step: int, **extra: Any) -> None:
+        rec = {"step": step, "ts": time.time()}
+        rec.update(extra)
+        self._f.write(json.dumps(rec) + "\n")
+
+
 def worker_main() -> None:
+    t_proc = time.time()
+    rg = int(os.environ["REPLICA_GROUP_ID"])
+    target = int(os.environ["TPUFT_BENCH_TARGET_STEPS"])
+    events_dir = os.environ["TPUFT_BENCH_EVENTS_DIR"]
+    mode = os.environ.get("TPUFT_BENCH_MODE", "ddp")
+    ev = _EventLog(os.path.join(events_dir, f"replica_{rg}.jsonl"))
+    ev.phase("proc_start", ts_override=t_proc)
+    stop_path = os.path.join(events_dir, "stop")
+
     _configure_jax(os.environ.get("TPUFT_BENCH_WORKER_PLATFORM") or None)
 
     import jax
     import jax.numpy as jnp
     import optax
 
-    from torchft_tpu.communicator import TCPCommunicator
-    from torchft_tpu.ddp import ft_allreduce
+    from torchft_tpu import tier as tier_mod
     from torchft_tpu.manager import Manager
-    from torchft_tpu.optim import OptimizerWrapper
 
-    rg = int(os.environ["REPLICA_GROUP_ID"])
-    target = int(os.environ["TPUFT_BENCH_TARGET_STEPS"])
-    events_dir = os.environ["TPUFT_BENCH_EVENTS_DIR"]
-    events_path = os.path.join(events_dir, f"replica_{rg}.jsonl")
-    stop_path = os.path.join(events_dir, "stop")
+    device = jax.devices()[0]  # forces backend init (tunnel dial on TPU)
+    ev.phase("jax_ready")
+
     sizes = {
-        k: int(os.environ[f"TPUFT_BENCH_{k.upper()}"])
-        for k in ("dim", "layers", "seq", "batch")
+        f"fleet_{k}": int(os.environ[f"TPUFT_BENCH_{k.upper()}"])
+        for k in ("dim", "layers", "seq", "batch", "head_dim")
     }
-    sizes["steps"] = target
-
-    model, config = _build_model(sizes)
-    device = jax.devices()[0]
+    model, config = _build_model(sizes, fleet=True)
     # identical init on every replica (the reference seeds identically in its
     # examples; init_sync covers the general case)
     params = jax.device_put(model.init(jax.random.PRNGKey(0)), device)
-    tx = optax.adamw(1e-3)
-    holder = {"params": params, "opt_state": jax.jit(tx.init)(params)}
+    inner_tx = optax.adamw(1e-3)
+    holder = {"params": params, "opt_state": jax.jit(inner_tx.init)(params)}
 
     # distinct per-replica data so the replica-dim average does real work
     key = jax.random.PRNGKey(1000 + rg)
+    batch_shape = (sizes["fleet_batch"], sizes["fleet_seq"])
     batches = []
     for i in range(4):
         k = jax.random.fold_in(key, i)
-        tokens = jax.random.randint(
-            k, (sizes["batch"], sizes["seq"]), 0, config.vocab_size
-        )
+        tokens = jax.random.randint(k, batch_shape, 0, config.vocab_size)
         batches.append(
             (jax.device_put(tokens, device), jnp.roll(tokens, -1, axis=1))
         )
+    grad_step = jax.jit(jax.value_and_grad(model.loss))
+    ev.phase("model_ready")
 
+    tier = tier_mod.default_tier()
     manager = Manager(
-        comm=TCPCommunicator(timeout_s=30.0),
+        comm=tier_mod.make_communicator(timeout_s=30.0, tier=tier),
         load_state_dict=lambda s: holder.update(s),
         state_dict=lambda: dict(holder),
         min_replica_size=1,
         replica_id=f"bench_{rg}",
+        use_async_quorum=(mode == "ddp"),
+        server_cls=tier_mod.manager_server_cls(tier),
     )
-    opt = OptimizerWrapper(manager, tx)
-    grad_step = jax.jit(jax.value_and_grad(model.loss))
+    ev.phase("manager_ready", tier=tier)
 
-    # the parent ends the phase via the stop file (so a healing victim gets
-    # to rejoin even after the survivor passed the measurement target);
-    # the hard cap is a runaway backstop
-    with open(events_path, "a", buffering=1) as ev:
-        while (
-            not os.path.exists(stop_path)
-            and manager.current_step() < target * 5
-        ):
-            opt.start_step()
-            batch = batches[manager.current_step() % len(batches)]
-            loss, grads = grad_step(holder["params"], batch)
-            grads = ft_allreduce(manager, grads)
-            if opt.step(holder, grads):
-                ev.write(
-                    json.dumps(
-                        {"step": manager.current_step(), "ts": time.time()}
-                    )
-                    + "\n"
-                )
+    if mode == "diloco":
+        _worker_diloco(ev, manager, holder, grad_step, inner_tx, batches,
+                       target, stop_path)
+    else:
+        _worker_ddp(ev, manager, holder, grad_step, inner_tx, batches,
+                    target, stop_path)
     manager.shutdown()
 
 
+def _worker_ddp(ev, manager, holder, grad_step, tx, batches, target,
+                stop_path) -> None:
+    from torchft_tpu.ddp import ft_allreduce
+    from torchft_tpu.optim import OptimizerWrapper
+
+    opt = OptimizerWrapper(manager, tx)
+    first = True
+    # the parent ends the phase via the stop file (so a healing victim gets
+    # to rejoin even after the survivor passed the measurement target);
+    # the hard cap is a runaway backstop
+    while not os.path.exists(stop_path) and manager.current_step() < target * 5:
+        opt.start_step()
+        batch = batches[manager.current_step() % len(batches)]
+        loss, grads = grad_step(holder["params"], batch)
+        grads = ft_allreduce(manager, grads)
+        if opt.step(holder, grads):
+            if first:
+                # quorum timings of the joining round: rpc (incl. barrier +
+                # join window), rendezvous/configure, heal transfer
+                ev.phase("first_commit", timings=manager.last_quorum_timings)
+                first = False
+            ev.step(manager.current_step())
+
+
+def _worker_diloco(ev, manager, holder, grad_step, inner_tx, batches,
+                   target, stop_path) -> None:
+    import optax
+
+    from torchft_tpu.local_sgd import DiLoCo
+
+    sync_every = int(os.environ.get("TPUFT_BENCH_DILOCO_SYNC", "8"))
+    fragments = int(os.environ.get("TPUFT_BENCH_DILOCO_FRAGMENTS", "2"))
+    delay = int(os.environ.get("TPUFT_BENCH_DILOCO_DELAY", "2"))
+    diloco = DiLoCo(
+        manager,
+        holder,
+        outer_tx=optax.sgd(0.7, momentum=0.9, nesterov=True),
+        sync_every=sync_every,
+        num_fragments=fragments,
+        fragment_sync_delay=delay,
+    )
+    inner = 0
+    first = True
+    with diloco:
+        while not os.path.exists(stop_path) and inner < target * 5:
+            batch = batches[inner % len(batches)]
+            loss, grads = grad_step(holder["params"], batch)
+            updates, holder["opt_state"] = inner_tx.update(
+                grads, holder["opt_state"], holder["params"]
+            )
+            holder["params"] = optax.apply_updates(holder["params"], updates)
+            inner += 1
+            committed = diloco.step()
+            if committed is not None and first:
+                ev.phase("first_commit", timings=manager.last_quorum_timings)
+                first = False
+            # cyc: position in the sync cycle (the parent times churn kills
+            # to land in the fragment-sync window, cyc >= per_frag - delay);
+            # outer: committed outer steps
+            ev.step(
+                inner,
+                outer=manager.current_step(),
+                cyc=diloco._local_step,
+                sync=committed is not None,
+            )
+
+
 # --------------------------------------------------------------------------
-# fleet orchestration (phases B and C)
+# fleet orchestration (phases B, C, D)
 # --------------------------------------------------------------------------
 
 
-def _read_events(events_dir: str, rg: int) -> List[Tuple[int, float]]:
+def _read_records(events_dir: str, rg: int) -> List[Dict[str, Any]]:
     path = os.path.join(events_dir, f"replica_{rg}.jsonl")
-    out: List[Tuple[int, float]] = []
+    out: List[Dict[str, Any]] = []
     try:
         with open(path) as f:
             for line in f:
                 try:
-                    rec = json.loads(line)
-                    out.append((rec["step"], rec["ts"]))
-                except (json.JSONDecodeError, KeyError):
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
                     continue  # torn final line of a SIGKILLed writer
     except FileNotFoundError:
         pass
+    return out
+
+
+def _steps_of(records: List[Dict[str, Any]]) -> List[Tuple[int, float]]:
+    return [
+        (r["step"], r["ts"]) for r in records if "step" in r and "ts" in r
+    ]
+
+
+def _phases_of(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    out = []
+    for r in records:
+        if "phase" in r:
+            r = dict(r)
+            # proc_start records the pre-import timestamp explicitly
+            if "ts_override" in r:
+                r["ts"] = r.pop("ts_override")
+            out.append(r)
     return out
 
 
@@ -248,28 +384,39 @@ def run_fleet(
     worker_platform: Optional[str],
     kill_every: int = 0,
     replicas: int = 2,
-    deadline_s: float = 360.0,
+    mode: str = "ddp",
+    kill_in_sync_window: bool = False,
+    max_kills: Optional[int] = None,
+    deadline_s: Optional[float] = None,
 ) -> Dict[str, Any]:
-    """Run a fleet of replica-group subprocesses to ``target_steps``; if
-    ``kill_every`` > 0, SIGKILL replica 1 every ``kill_every`` survivor
-    steps (once the victim has rejoined).  Returns throughput + heal stats
-    computed from the per-replica committed-step event logs."""
+    """Run a fleet of replica-group subprocesses to ``target_steps`` on the
+    anchor (replica 0, never killed); if ``kill_every`` > 0, SIGKILL a
+    rotating victim among replicas 1..N-1 every ``kill_every`` anchor steps
+    (waiting for the previous victim to rejoin first, so each heal-in is
+    well defined).  Returns throughput + heal stats from the per-replica
+    event logs."""
+    from torchft_tpu import tier as tier_mod
     from torchft_tpu.launcher import ReplicaSpec, ReplicaSupervisor
-    from torchft_tpu.lighthouse import LighthouseServer
 
     events_dir = tempfile.mkdtemp(prefix=f"tpuft_bench_{label}_")
-    lighthouse = LighthouseServer(
+    tier = tier_mod.default_tier()
+    lighthouse = tier_mod.make_lighthouse(
         bind="127.0.0.1:0",
         min_replicas=1,
         join_timeout_ms=3000,
         quorum_tick_ms=50,
+        tier=tier,
     )
     env = {
         "TPUFT_BENCH_EVENTS_DIR": events_dir,
         "TPUFT_BENCH_TARGET_STEPS": str(target_steps),
         "TPUFT_BENCH_WORKER_PLATFORM": worker_platform or "",
+        "TPUFT_BENCH_MODE": mode,
+        "TPUFT_BENCH_DILOCO_SYNC": str(sizes["diloco_sync_every"]),
+        "TPUFT_BENCH_DILOCO_FRAGMENTS": str(sizes["diloco_fragments"]),
+        "TPUFT_BENCH_DILOCO_DELAY": str(sizes["diloco_sync_delay"]),
     }
-    for k in ("dim", "layers", "seq", "batch"):
+    for k in ("dim", "layers", "seq", "batch", "head_dim"):
         env[f"TPUFT_BENCH_{k.upper()}"] = str(sizes[f"fleet_{k}"])
     specs = [
         ReplicaSpec(
@@ -287,22 +434,36 @@ def run_fleet(
     runner = threading.Thread(target=supervisor.run, daemon=True)
     runner.start()
 
+    # fragment-sync window start, in inner-cycle position (phase D kills
+    # must land while the pseudogradient allreduce is in flight)
+    per_frag = sizes["diloco_sync_every"] // sizes["diloco_fragments"]
+    sync_cyc = per_frag - sizes["diloco_sync_delay"]
+
     kills: List[Dict[str, Any]] = []
     next_kill = kill_every
+    victim = 1 if replicas > 1 else 0
+    if deadline_s is None:
+        deadline_s = 240.0 + 3.0 * target_steps + 90.0 * (
+            (target_steps // kill_every) if kill_every else 0
+        )
     deadline = time.time() + deadline_s
-    heal_grace_s = 90.0
+    heal_grace_s = 120.0
     stop_path = os.path.join(events_dir, "stop")
     try:
         while time.time() < deadline:
-            ev0 = _read_events(events_dir, 0)
-            ev1 = _read_events(events_dir, 1)
-            # victim counts as (re)joined once it has committed a step since
-            # the last kill (or at all, before the first kill)
-            victim_back = bool(ev1) and (
-                not kills or ev1[-1][1] > kills[-1]["ts"]
-            )
-            if ev0 and ev0[-1][0] >= target_steps:
-                # survivor hit the measurement target; linger (bounded) so a
+            anchor = _steps_of(_read_records(events_dir, 0))
+            # gate on the PREVIOUS kill's victim having rejoined (committed
+            # a step since its kill) — with rotation the next victim is a
+            # different, healthy replica, and killing it while the last one
+            # is still healing would overlap heals and corrupt attribution
+            victim_back = bool(_steps_of(_read_records(events_dir, victim)))
+            if kills:
+                prev = _steps_of(_read_records(events_dir, kills[-1]["victim"]))
+                victim_back = (
+                    victim_back and bool(prev) and prev[-1][1] > kills[-1]["ts"]
+                )
+            if anchor and anchor[-1][0] >= target_steps:
+                # anchor hit the measurement target; linger (bounded) so a
                 # mid-heal victim gets to rejoin — that rejoin is the
                 # heal-in data point
                 if (
@@ -313,20 +474,42 @@ def run_fleet(
                     break
             elif (
                 kill_every
-                and ev0
-                and ev0[-1][0] >= next_kill
+                and anchor
+                and anchor[-1][0] >= next_kill
                 and victim_back
-                and supervisor.kill(1)
+                and (max_kills is None or len(kills) < max_kills)
             ):
-                # only re-kill once the victim has rejoined (committed a step
-                # since the last kill), so each heal-in is well defined
-                kills.append({"ts": time.time(), "survivor_step": ev0[-1][0]})
-                print(
-                    f"bench[{label}]: killed replica 1 at survivor "
-                    f"step {ev0[-1][0]}",
-                    file=sys.stderr,
-                )
-                next_kill = ev0[-1][0] + kill_every
+                if kill_in_sync_window:
+                    # only pull the trigger while the victim reports being
+                    # inside the fragment-sync window
+                    cyc = next(
+                        (
+                            r.get("cyc")
+                            for r in reversed(_read_records(events_dir, victim))
+                            if "step" in r
+                        ),
+                        None,
+                    )
+                    if cyc is None or cyc < sync_cyc:
+                        time.sleep(0.1)
+                        continue
+                if supervisor.kill(victim):
+                    kills.append(
+                        {
+                            "ts": time.time(),
+                            "survivor_step": anchor[-1][0],
+                            "victim": victim,
+                        }
+                    )
+                    print(
+                        f"bench[{label}]: killed replica {victim} at anchor "
+                        f"step {anchor[-1][0]}",
+                        file=sys.stderr,
+                    )
+                    next_kill = anchor[-1][0] + kill_every
+                    # rotate the victim among 1..N-1
+                    if replicas > 2:
+                        victim = 1 + (victim % (replicas - 1))
             time.sleep(0.25)
     finally:
         with open(stop_path, "w") as f:
@@ -335,115 +518,144 @@ def run_fleet(
         supervisor.stop()
         lighthouse.shutdown()
 
-    ev0 = _read_events(events_dir, 0)
-    ev1 = _read_events(events_dir, 1)
-    return _fleet_metrics(label, target_steps, ev0, ev1, kills)
+    records = [_read_records(events_dir, i) for i in range(replicas)]
+    return _fleet_metrics(label, target_steps, records, kills)
 
 
 def _fleet_metrics(
     label: str,
     target_steps: int,
-    ev0: List[Tuple[int, float]],
-    ev1: List[Tuple[int, float]],
+    records: List[List[Dict[str, Any]]],
     kills: List[Dict[str, Any]],
 ) -> Dict[str, Any]:
     """Throughput + heal statistics from the committed-step event logs.
 
-    Both replica processes share one physical chip in this harness, so the
-    survivor literally speeds up while its peer is dead (decontention) — a
+    All replica processes share one physical chip in this harness, so
+    survivors literally speed up while a peer is dead (decontention) — a
     raw with-faults/fault-free wall-clock ratio would overstate fault
-    tolerance.  Instead the fault cost is measured directly: the survivor's
-    steady-state step time during both-alive periods (``t_step_s``) vs the
+    tolerance.  Instead the fault cost is measured directly: the anchor's
+    steady-state step time during all-alive periods (``t_step_s``) vs the
     extra time its disrupted steps took around each kill and each rejoin
     (``overhead_per_kill_s``).  BASELINE's fault rate is one kill per 100
     steps, so the north-star ratio is ``100·t / (100·t + overhead)``.
     """
+    evs = [_steps_of(r) for r in records]
+    anchor = evs[0]
     result: Dict[str, Any] = {
         "label": label,
+        "replicas": len(records),
         "kills": len(kills),
-        "survivor_steps": ev0[-1][0] if ev0 else 0,
-        "completed": bool(ev0 and ev0[-1][0] >= target_steps),
+        "anchor_steps": anchor[-1][0] if anchor else 0,
+        "completed": bool(anchor and anchor[-1][0] >= target_steps),
     }
-    if len(ev0) < 2:
+    if len(anchor) < 2:
         return result
 
-    # per-step durations for the survivor: dts[i] = time to commit ev0[i]
+    # per-step durations for the anchor: dts[i] = time to commit anchor[i]
     dts = [
-        (ev0[i][0], ev0[i][1], ev0[i][1] - ev0[i - 1][1])
-        for i in range(1, len(ev0))
+        (anchor[i][0], anchor[i][1], anchor[i][1] - anchor[i - 1][1])
+        for i in range(1, len(anchor))
     ]
 
-    # both-alive steady state: steps committed while the victim was live
-    # (between its rejoin and the next kill), excluding 2 warmup steps after
-    # each (re)join
-    def _victim_alive(ts: float) -> bool:
-        if not ev1:
-            return False
-        alive = False
-        # victim is alive from each of its events until the next kill
-        last_kill = None
+    def _outstanding(ts: float) -> bool:
+        """True when some kill before ``ts`` has no victim rejoin yet."""
         for kill in kills:
-            if kill["ts"] <= ts:
-                last_kill = kill["ts"]
-        evs_before = [t for (_s, t) in ev1 if t <= ts]
-        if not evs_before:
-            return False
-        if last_kill is None:
-            return True
-        return max(evs_before) > last_kill
+            if kill["ts"] > ts:
+                continue
+            vic = evs[kill["victim"]]
+            if not any(kill["ts"] < t <= ts for (_s, t) in vic):
+                return True
+        return False
 
-    steady = [dt for (_s, ts, dt) in dts if _victim_alive(ts)]
-    # skip the slowest tail (rejoin warmup / heal pauses land inside
-    # both-alive windows); median is robust to them
+    steady = [dt for (_s, ts, dt) in dts if not _outstanding(ts)]
     if steady:
         steady_sorted = sorted(steady)
         t_step = steady_sorted[len(steady_sorted) // 2]
         result["t_step_s"] = round(t_step, 4)
-        result["survivor_steps_per_sec"] = round(1.0 / t_step, 3)
+        result["anchor_steps_per_sec"] = round(1.0 / t_step, 3)
     else:
         t_step = None
 
     # wall-clock throughput over the whole phase (raw, contention-skewed)
-    span_steps = ev0[-1][0] - ev0[0][0]
-    span_time = ev0[-1][1] - ev0[0][1]
+    span_steps = anchor[-1][0] - anchor[0][0]
+    span_time = anchor[-1][1] - anchor[0][1]
     if span_steps > 0 and span_time > 0:
-        result["survivor_steps_per_sec_raw"] = round(span_steps / span_time, 3)
+        result["anchor_steps_per_sec_raw"] = round(span_steps / span_time, 3)
 
-    # per-kill disruption: extra time (beyond steady t_step) of survivor
-    # steps from the kill until 3 steps after the victim's first committed
-    # step back (covers the failed step, both reconfigures, and the heal
-    # pause); heal-in = survivor steps the victim missed
-    heal_ins: List[int] = []
+    # DiLoCo: cost of a fragment sync = median sync-step time minus median
+    # plain-inner-step time (how well the τ-delayed allreduce overlaps)
+    anchor_steps_recs = [r for r in records[0] if "step" in r]
+    if t_step is not None and any(r.get("sync") for r in anchor_steps_recs):
+        by_ts = {r["ts"]: bool(r.get("sync")) for r in anchor_steps_recs}
+        sync_dts = sorted(
+            dt for (_s, ts, dt) in dts
+            if by_ts.get(ts) and not _outstanding(ts)
+        )
+        plain_dts = sorted(
+            dt for (_s, ts, dt) in dts
+            if not by_ts.get(ts) and not _outstanding(ts)
+        )
+        if sync_dts and plain_dts:
+            sync_t = sync_dts[len(sync_dts) // 2]
+            plain_t = plain_dts[len(plain_dts) // 2]
+            result["sync_step_s"] = round(sync_t, 4)
+            result["inner_step_s"] = round(plain_t, 4)
+            result["sync_overhead_s"] = round(max(0.0, sync_t - plain_t), 4)
+
+    # per-kill disruption + heal attribution
     heal_secs: List[float] = []
+    heal_steps: List[int] = []
     overheads: List[float] = []
+    breakdowns: List[Dict[str, float]] = []
+    by_victim: Dict[int, List[float]] = {}
     for kill in kills:
-        back = [(s, t) for (s, t) in ev1 if t > kill["ts"]]
+        vic = evs[kill["victim"]]
+        back = [(s, t) for (s, t) in vic if t > kill["ts"]]
         rejoin_ts = back[0][1] if back else None
         if rejoin_ts is not None:
-            survivor_at_rejoin = max(
-                (s for (s, t) in ev0 if t <= rejoin_ts),
+            heal_secs.append(rejoin_ts - kill["ts"])
+            by_victim.setdefault(kill["victim"], []).append(
+                rejoin_ts - kill["ts"]
+            )
+            anchor_at_rejoin = max(
+                (s for (s, t) in anchor if t <= rejoin_ts),
                 default=kill["survivor_step"],
             )
-            heal_ins.append(max(0, survivor_at_rejoin - kill["survivor_step"]))
-            heal_secs.append(rejoin_ts - kill["ts"])
+            heal_steps.append(
+                max(0, anchor_at_rejoin - kill["survivor_step"])
+            )
+            bd = _heal_breakdown(
+                records[kill["victim"]], kill["ts"], rejoin_ts
+            )
+            if bd:
+                breakdowns.append(bd)
         if t_step is not None:
             if rejoin_ts is not None:
                 window_end = rejoin_ts + 3 * t_step
             else:
                 window_end = kill["ts"] + 10 * t_step
             dis = [
-                dt
-                for (_s, ts, dt) in dts
-                if kill["ts"] <= ts <= window_end
+                dt for (_s, ts, dt) in dts if kill["ts"] <= ts <= window_end
             ]
             overheads.append(sum(max(0.0, dt - t_step) for dt in dis))
-    if heal_ins:
-        # heal-in in steps scales with the survivor's step time; seconds is
-        # the environment-independent number (process respawn + jax init +
-        # rejoin + heal transfer)
-        result["mean_heal_in_steps"] = round(sum(heal_ins) / len(heal_ins), 1)
+    if heal_secs:
+        # seconds is the environment-independent number (process respawn +
+        # jax init + rejoin + heal transfer); steps would scale with the
+        # survivor's decontended step time and mislead
         result["mean_heal_in_s"] = round(sum(heal_secs) / len(heal_secs), 1)
-        result["heal_ins"] = heal_ins
+        result["heal_in_s"] = [round(h, 1) for h in heal_secs]
+        result["heal_in_steps"] = heal_steps
+        result["heal_by_victim"] = {
+            str(v): [round(h, 1) for h in hs] for v, hs in by_victim.items()
+        }
+    if breakdowns:
+        keys = sorted({k for bd in breakdowns for k in bd})
+        result["heal_breakdown"] = {
+            k: round(
+                sum(bd.get(k, 0.0) for bd in breakdowns) / len(breakdowns), 2
+            )
+            for k in keys
+        }
     if overheads:
         result["overhead_per_kill_s"] = round(
             sum(overheads) / len(overheads), 3
@@ -456,8 +668,42 @@ def _fleet_metrics(
     return result
 
 
+def _heal_breakdown(
+    victim_records: List[Dict[str, Any]],
+    kill_ts: float,
+    rejoin_ts: float,
+) -> Dict[str, float]:
+    """Attribute one victim rejoin to phases, from its phase log:
+    respawn (supervisor delay + python boot), jax_init (backend/tunnel
+    dial), model_build (init + device_put + trace), manager (ctor + server
+    + store), join_heal (quorum rpc incl. join window, rendezvous,
+    checkpoint transfer — sub-attributed from Manager timings), first_step
+    (compile + step math up to the first committed event)."""
+    phases = [
+        p for p in _phases_of(victim_records) if kill_ts < p["ts"] <= rejoin_ts
+    ]
+    t = {p["phase"]: p for p in phases}
+    out: Dict[str, float] = {}
+    prev = kill_ts
+    for name, key in (
+        ("proc_start", "respawn_s"),
+        ("jax_ready", "jax_init_s"),
+        ("model_ready", "model_build_s"),
+        ("manager_ready", "manager_s"),
+    ):
+        if name in t:
+            out[key] = t[name]["ts"] - prev
+            prev = t[name]["ts"]
+    out["join_to_first_commit_s"] = rejoin_ts - prev
+    fc = t.get("first_commit")
+    if fc and isinstance(fc.get("timings"), dict):
+        for k, v in fc["timings"].items():
+            out[f"quorum_{k}"] = v
+    return {k: round(v, 3) for k, v in out.items()}
+
+
 # --------------------------------------------------------------------------
-# phase A: single-chip ws=1 overhead + absolute tokens/sec/chip
+# phase A: single-chip ws=1 overhead + absolute tokens/sec/chip + MFU
 # --------------------------------------------------------------------------
 
 
@@ -466,19 +712,21 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
     import jax.numpy as jnp
     import optax
 
-    from torchft_tpu.communicator import TCPCommunicator
+    from torchft_tpu import tier as tier_mod
     from torchft_tpu.ddp import ft_allreduce
-    from torchft_tpu.lighthouse import LighthouseServer
     from torchft_tpu.manager import Manager
     from torchft_tpu.optim import OptimizerWrapper
 
     steps = sizes["steps"]
     model, config = _build_model(sizes)
     device = jax.devices()[0]
+    flash = model._use_flash(sizes["seq"])
     print(
-        f"bench: llama dim={sizes['dim']} layers={sizes['layers']} "
+        f"bench: llama dim={config.dim} layers={config.n_layers} "
         f"seq={sizes['seq']} batch={sizes['batch']} "
-        f"params={model.num_params()/1e6:.1f}M on {device.platform}",
+        f"heads={config.n_heads}x{config.head_dim} "
+        f"params={model.num_params()/1e6:.1f}M remat={config.remat} "
+        f"flash={flash} on {device.platform} ({device.device_kind})",
         file=sys.stderr,
     )
 
@@ -505,7 +753,7 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
     ff_params = jax.tree_util.tree_map(jnp.copy, params)
     opt_state = jax.jit(tx.init)(ff_params)
     # several warmup steps: the first post-compile iterations can run slow
-    # (autotuning/tunnel warm-up) and would skew a 20-step measurement
+    # (autotuning/tunnel warm-up) and would skew the measurement
     for _ in range(4):
         loss, grads = grad_step(ff_params, batch_data)
         ff_params, opt_state = update_step(ff_params, opt_state, grads)
@@ -523,18 +771,24 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
         file=sys.stderr,
     )
 
-    # full FT stack, ws=1
-    lighthouse = LighthouseServer(
-        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=50, quorum_tick_ms=20
+    # full FT stack, ws=1, on the production tier
+    tier = tier_mod.default_tier()
+    lighthouse = tier_mod.make_lighthouse(
+        bind="127.0.0.1:0",
+        min_replicas=1,
+        join_timeout_ms=50,
+        quorum_tick_ms=20,
+        tier=tier,
     )
     holder = {"params": params, "opt_state": jax.jit(tx.init)(params)}
     manager = Manager(
-        comm=TCPCommunicator(timeout_s=60.0),
+        comm=tier_mod.make_communicator(timeout_s=60.0, tier=tier),
         load_state_dict=lambda s: holder.update(s),
         state_dict=lambda: dict(holder),
         min_replica_size=1,
         replica_id="bench_0",
         lighthouse_addr=lighthouse.local_address(),
+        server_cls=tier_mod.manager_server_cls(tier),
     )
     opt = OptimizerWrapper(manager, tx)
 
@@ -564,9 +818,9 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
     # excludes the embedding table (a gather, not a matmul — PaLM MFU
     # convention) but keeps the lm_head projection, which is a real matmul
     matmul_params = model.num_params() - config.vocab_size * config.dim
-    flops_per_token = 6 * matmul_params + 12 * sizes["layers"] * sizes[
-        "dim"
-    ] * sizes["seq"]
+    flops_per_token = (
+        6 * matmul_params + 12 * config.n_layers * config.dim * sizes["seq"]
+    )
     tflops = ft_tps * flops_per_token / 1e12
     out = {
         "faultfree_tokens_per_sec": round(faultfree_tps, 1),
@@ -574,12 +828,22 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
         "ws1_ratio": round(ft_tps / faultfree_tps, 4),
         "model_tflops_per_sec": round(tflops, 2),
         "platform": device.platform,
+        "device_kind": device.device_kind,
+        "tier": tier,
+        "remat": bool(config.remat),
+        "flash": bool(flash),
     }
-    peak = os.environ.get("TPUFT_PEAK_TFLOPS")
+    peak = _peak_tflops(device)
     if peak:
-        out["mfu"] = round(tflops / float(peak), 4)
+        out["peak_tflops"] = peak
+        out["mfu"] = round(tflops / peak, 4)
+        if config.remat:
+            # full remat re-runs the forward in the backward: hardware does
+            # ~8N/token against the 6N the MFU convention counts
+            out["hw_mfu_est"] = round(tflops * (8.0 / 6.0) / peak, 4)
     print(
-        f"bench: {tflops:.2f} model TFLOP/s achieved (ft path)",
+        f"bench: {tflops:.2f} model TFLOP/s achieved (ft path), "
+        f"mfu={out.get('mfu')}",
         file=sys.stderr,
     )
     return out
@@ -604,14 +868,17 @@ def main() -> None:
     single = run_single(sizes)
 
     faults: Dict[str, Any] = {}
+    diloco: Dict[str, Any] = {}
     ratio = None
     if not os.environ.get("TPUFT_BENCH_SKIP_FLEET"):
         worker_platform = "cpu" if on_cpu else None
+        replicas = max(2, sizes["replicas"])
         faultfree = run_fleet(
             "faultfree",
             target_steps=max(10, sizes["fleet_steps"] // 3),
             sizes=sizes,
             worker_platform=worker_platform,
+            replicas=replicas,
         )
         print(f"bench: fleet fault-free {faultfree}", file=sys.stderr)
         faulted = run_fleet(
@@ -620,20 +887,24 @@ def main() -> None:
             sizes=sizes,
             worker_platform=worker_platform,
             kill_every=sizes["kill_every"],
+            replicas=replicas,
         )
         print(f"bench: fleet with faults {faulted}", file=sys.stderr)
         faults = {
             "fleet_steps": sizes["fleet_steps"],
             "kill_every": sizes["kill_every"],
+            "replicas": replicas,
             "kills": faulted.get("kills", 0),
             "faultfree_fleet": faultfree,
             "faulted_fleet": faulted,
         }
-        if faulted.get("mean_heal_in_steps") is not None:
-            faults["mean_heal_in_steps"] = faulted["mean_heal_in_steps"]
-        if faulted.get("mean_heal_in_s") is not None:
-            faults["mean_heal_in_s"] = faulted["mean_heal_in_s"]
+        for k in ("mean_heal_in_s", "heal_breakdown"):
+            if faulted.get(k) is not None:
+                faults[k] = faulted[k]
         ratio = faulted.get("ratio_per_100step_kill")
+
+        if not os.environ.get("TPUFT_BENCH_SKIP_DILOCO"):
+            diloco = _run_diloco_phase(sizes, worker_platform, replicas)
 
     if ratio is None:
         # fleet phases unusable: fall back to the ws=1 protocol ratio so the
@@ -652,13 +923,75 @@ def main() -> None:
         "value": round(ratio, 4),
         "unit": "ratio",
         "vs_baseline": round(ratio / 0.95, 4),
+        # which quantized-allreduce reduction path this env would run
+        # (device Pallas dequant-sum-requant vs host): recorded because the
+        # tunnel auto-gates the device path off (benchmarks/RESULTS.md)
+        "quant_device_reduce": _quant_device_reduce_active(),
         **single,
     }
     if faults:
         out["faults"] = faults
-        if "mean_heal_in_steps" in faults:
-            out["mean_heal_in_steps"] = round(faults["mean_heal_in_steps"], 1)
+        if "mean_heal_in_s" in faults:
+            out["mean_heal_in_s"] = faults["mean_heal_in_s"]
+    if diloco:
+        out["diloco"] = diloco
     print(json.dumps(out))
+
+
+def _quant_device_reduce_active() -> bool:
+    from torchft_tpu.collectives import _use_device_reduce
+
+    return bool(_use_device_reduce(1 << 20))
+
+
+def _run_diloco_phase(
+    sizes: Dict[str, int], worker_platform: Optional[str], replicas: int
+) -> Dict[str, Any]:
+    """Phase D: Streaming DiLoCo islands, fault-free vs churn with kills
+    timed into the fragment-sync window (BASELINE config 4)."""
+    faultfree = run_fleet(
+        "diloco_faultfree",
+        target_steps=max(12, sizes["diloco_steps"] // 2),
+        sizes=sizes,
+        worker_platform=worker_platform,
+        replicas=replicas,
+        mode="diloco",
+    )
+    print(f"bench: diloco fault-free {faultfree}", file=sys.stderr)
+    churn = run_fleet(
+        "diloco_churn",
+        target_steps=sizes["diloco_steps"],
+        sizes=sizes,
+        worker_platform=worker_platform,
+        replicas=replicas,
+        mode="diloco",
+        kill_every=max(
+            sizes["diloco_sync_every"],
+            sizes["diloco_steps"] // (sizes["diloco_kills"] + 1),
+        ),
+        kill_in_sync_window=True,
+        max_kills=sizes["diloco_kills"],
+    )
+    print(f"bench: diloco churn {churn}", file=sys.stderr)
+    out: Dict[str, Any] = {
+        "sync_every": sizes["diloco_sync_every"],
+        "fragments": sizes["diloco_fragments"],
+        "fragment_sync_delay": sizes["diloco_sync_delay"],
+        "kills_in_sync_window": churn.get("kills", 0),
+        "faultfree": faultfree,
+        "churn": churn,
+    }
+    tf = faultfree.get("t_step_s")
+    tc = churn.get("t_step_s")
+    if tf and tc:
+        out["inner_step_ratio"] = round(tf / tc, 4)
+    if faultfree.get("sync_overhead_s") is not None:
+        out["sync_overhead_s"] = faultfree["sync_overhead_s"]
+    if churn.get("ratio_per_100step_kill") is not None:
+        out["ratio_per_100step_kill"] = churn["ratio_per_100step_kill"]
+    if churn.get("mean_heal_in_s") is not None:
+        out["mean_heal_in_s"] = churn["mean_heal_in_s"]
+    return out
 
 
 if __name__ == "__main__":

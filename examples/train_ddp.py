@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from torchft_tpu.communicator import TCPCommunicator
+from torchft_tpu.tier import default_tier, make_communicator, manager_server_cls
 from torchft_tpu.data import DistributedSampler, batch_indices
 from torchft_tpu.ddp import ft_allreduce
 from torchft_tpu.manager import Manager
@@ -95,12 +95,14 @@ def main() -> None:
     tx = optax.adam(args.lr)
     holder = {"params": params, "opt_state": tx.init(params)}
 
+    tier = default_tier()  # C++ plane when native/libtpuft.so loads
     manager = Manager(
-        comm=TCPCommunicator(timeout_s=args.comm_timeout),
+        comm=make_communicator(timeout_s=args.comm_timeout, tier=tier),
         load_state_dict=lambda s: holder.update(s),
         state_dict=lambda: dict(holder),
         min_replica_size=args.min_replicas,
         replica_id=f"train_ddp_{args.replica_group_id}",
+        server_cls=manager_server_cls(tier),
     )
     opt = OptimizerWrapper(manager, tx)
 

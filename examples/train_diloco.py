@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from torchft_tpu.communicator import TCPCommunicator
+from torchft_tpu.tier import default_tier, make_communicator, manager_server_cls
 from torchft_tpu.local_sgd import DiLoCo
 from torchft_tpu.manager import Manager
 from torchft_tpu.optim import OptimizerWrapper  # noqa: F401 (inner loop is plain optax)
@@ -85,14 +85,16 @@ def main() -> None:
     holder = {"params": params}
     inner_state = inner_tx.init(params)
 
+    tier = default_tier()  # C++ plane when native/libtpuft.so loads
     manager = Manager(
-        comm=TCPCommunicator(timeout_s=60.0),
+        comm=make_communicator(timeout_s=60.0, tier=tier),
         load_state_dict=lambda s: holder.update(s),
         state_dict=lambda: dict(holder),
         min_replica_size=args.min_replicas,
         use_async_quorum=False,  # DiLoCo requires a synchronous quorum
         replica_id=f"train_diloco_{args.replica_group_id}",
         quorum_timeout=120.0,
+        server_cls=manager_server_cls(tier),
     )
     diloco = DiLoCo(
         manager,

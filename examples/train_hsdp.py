@@ -51,7 +51,7 @@ def main() -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
-    from torchft_tpu.communicator import TCPCommunicator
+    from torchft_tpu.tier import default_tier, make_communicator, manager_server_cls
     from torchft_tpu.manager import Manager
     from torchft_tpu.models.llama import Llama, llama_debug
     from torchft_tpu.parallel.hsdp import HSDPTrainer, fsdp_shardings
@@ -61,12 +61,14 @@ def main() -> None:
     config = llama_debug()
     model = Llama(config)
 
+    tier = default_tier()  # C++ plane when native/libtpuft.so loads
     manager = Manager(
-        comm=TCPCommunicator(timeout_s=60.0),
+        comm=make_communicator(timeout_s=60.0, tier=tier),
         load_state_dict=None,  # HSDPTrainer registers its own entry
         state_dict=None,
         min_replica_size=args.min_replicas,
         replica_id=f"train_hsdp_{args.replica_group_id}",
+        server_cls=manager_server_cls(tier),
     )
     trainer = HSDPTrainer(
         model, optax.adamw(1e-3), mesh, manager, key=jax.random.PRNGKey(0)

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from torchft_tpu.communicator import TCPCommunicator
+from torchft_tpu.tier import default_tier, make_communicator, manager_server_cls
 from torchft_tpu.local_sgd import LocalSGD
 from torchft_tpu.manager import Manager
 from torchft_tpu.models.cnn import SimpleCNN
@@ -63,13 +63,15 @@ def main() -> None:
     tx = optax.adam(1e-3)
     holder = {"params": params, "opt_state": tx.init(params)}
 
+    tier = default_tier()  # C++ plane when native/libtpuft.so loads
     manager = Manager(
-        comm=TCPCommunicator(timeout_s=60.0),
+        comm=make_communicator(timeout_s=60.0, tier=tier),
         load_state_dict=lambda s: holder.update(s),
         state_dict=lambda: dict(holder),
         min_replica_size=args.min_replicas,
         replica_id=f"train_localsgd_{args.replica_group_id}",
         quorum_timeout=120.0,
+        server_cls=manager_server_cls(tier),
     )
 
     # restore from the latest durable checkpoint (job-level resume)

@@ -19,6 +19,10 @@ reference's Monarch FailureActor exercises
 - ``commabort`` comm-kill: the communicator is aborted under the replica
                 (NIC-failure analog); the step fails and the next quorum
                 reconfigures with no process restart
+- ``lighthouse`` coordination-plane death: the lighthouse is torn down and
+                restarted on the same port with EMPTY state; replicas must
+                re-register on their next quorum round (soft state,
+                ``src/lighthouse.rs:292-343``) with no replica restarts
 
 At the end all survivors must hold identical state and have committed a
 healthy fraction of attempted steps.
@@ -58,7 +62,7 @@ class KillSignal(Exception):
     pass
 
 
-FAILURE_CLASSES = ("kill", "wedge", "commabort")
+FAILURE_CLASSES = ("kill", "wedge", "commabort", "lighthouse")
 
 
 class SoakReplica:
@@ -153,16 +157,20 @@ def main() -> None:
     )
     args = parser.parse_args()
 
-    lighthouse = LighthouseServer(
-        bind="127.0.0.1:0",
-        min_replicas=1,
-        join_timeout_ms=200,
-        quorum_tick_ms=20,
-        heartbeat_timeout_ms=1000,
-    )
+    def make_lighthouse(bind: str = "127.0.0.1:0") -> LighthouseServer:
+        return LighthouseServer(
+            bind=bind,
+            min_replicas=1,
+            join_timeout_ms=200,
+            quorum_tick_ms=20,
+            heartbeat_timeout_ms=1000,
+        )
+
+    lh = {"srv": make_lighthouse()}
+    lh_port = lh["srv"].port
     stop = threading.Event()
     replicas = [
-        SoakReplica(i, lighthouse.local_address(), stop, backend=args.backend)
+        SoakReplica(i, lh["srv"].local_address(), stop, backend=args.backend)
         for i in range(args.replicas)
     ]
 
@@ -188,6 +196,13 @@ def main() -> None:
                 # + eviction), sometimes a mere straggler stall
                 victim.wedge_secs = rng.uniform(2.0, 22.0)
                 victim.wedge_flag.set()
+            elif cls == "lighthouse":
+                # kill + restart the coordination plane on the same port;
+                # in-flight quorums fail (connections are severed), replicas
+                # re-register against the empty soft state next round
+                lh["srv"].shutdown()
+                time.sleep(1.0)
+                lh["srv"] = make_lighthouse(f"127.0.0.1:{lh_port}")
             else:  # commabort
                 comm = getattr(victim, "comm", None)
                 if comm is None:
@@ -207,7 +222,7 @@ def main() -> None:
         for f in futures:
             f.result(timeout=60.0)
 
-    lighthouse.shutdown()
+    lh["srv"].shutdown()
 
     total_commits = sum(r.commits for r in replicas)
     total_attempts = sum(r.attempts for r in replicas)

@@ -60,6 +60,7 @@ class _ChaosReplica:
         self.wedge_secs = 0.0
         self.abort_at: Optional[int] = None
         self.failed_steps = 0
+        self.progress = 0  # latest committed step, for outside observers
         self.final: Optional[Dict] = None
         self.error: Optional[BaseException] = None
 
@@ -104,6 +105,7 @@ class _ChaosReplica:
                 grads = ft_allreduce(manager, grads)
                 if not opt.step(holder, grads):
                     self.failed_steps += 1
+                self.progress = manager.current_step()
             self.final = jax.tree_util.tree_map(np.asarray, dict(holder))
         finally:
             manager.shutdown()
@@ -218,3 +220,96 @@ def test_sigstop_process_wedge_evicts_and_heals(tmp_path) -> None:
         assert m, f"replica {gid} never printed FINAL (log: {path.read_text()[-2000:]})"
         hashes[gid] = m[-1]
     assert hashes[0] == hashes[1], f"replicas diverged: {hashes}"
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_lighthouse(addr: str, deadline_s: float = 30.0) -> None:
+    import socket
+
+    host, port = addr.rsplit(":", 1)
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        try:
+            socket.create_connection((host, int(port)), timeout=1.0).close()
+            return
+        except OSError:
+            time.sleep(0.1)
+    pytest.fail(f"lighthouse never came up on {addr}")
+
+
+def _spawn_lighthouse(addr: str) -> subprocess.Popen:
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "torchft_tpu.lighthouse",
+            "--bind",
+            addr,
+            "--min_replicas",
+            "1",
+            "--join_timeout_ms",
+            "200",
+            "--quorum_tick_ms",
+            "20",
+            "--heartbeat_timeout_ms",
+            "1500",
+        ],
+        cwd=str(REPO),
+    )
+    _wait_lighthouse(addr)
+    return proc
+
+
+def test_lighthouse_kill_restart_soft_state() -> None:
+    """SIGKILL the lighthouse mid-run, restart it on the same port: every
+    replica re-registers on its next quorum round and training resumes with
+    NO replica restarts.  This is the point of the lighthouse's soft state —
+    participants re-register every round, nothing needs to be recovered
+    (``src/lighthouse.rs:292-343``); the manager server re-creates its
+    lighthouse client after a failed forward (``src/manager.rs:250-306``)."""
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    lh = _spawn_lighthouse(addr)
+    r0 = _ChaosReplica(0, addr, steps=40, timeout_s=5.0)
+    r1 = _ChaosReplica(1, addr, steps=40, timeout_s=5.0)
+    threads = [threading.Thread(target=r.run, daemon=True) for r in (r0, r1)]
+    try:
+        for t in threads:
+            t.start()
+        # let the fleet commit real steps on lighthouse #1
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and min(r0.progress, r1.progress) < 5:
+            time.sleep(0.1)
+        assert min(r0.progress, r1.progress) >= 5, "fleet never got going"
+
+        lh.kill()  # SIGKILL: no goodbye to connected managers
+        lh.wait(timeout=10)
+        progress_at_kill = max(r0.progress, r1.progress)
+        time.sleep(2.0)  # an outage long enough to fail in-flight quorums
+        lh = _spawn_lighthouse(addr)
+
+        end = time.monotonic() + 120
+        for t in threads:
+            t.join(timeout=max(1.0, end - time.monotonic()))
+        for r in (r0, r1):
+            assert r.error is None, f"replica {r.idx} died: {r.error!r}"
+            assert r.final is not None, f"replica {r.idx} never finished"
+        # commits resumed AFTER the restart (the target lies beyond the kill
+        # point), against the restarted lighthouse's empty soft state
+        assert progress_at_kill < 40
+        np.testing.assert_array_equal(
+            r0.final["params"]["w"], r1.final["params"]["w"]
+        )
+    finally:
+        if lh.poll() is None:
+            lh.terminate()
+            lh.wait(timeout=10)

@@ -60,6 +60,20 @@ class TestSerialization:
         restored = loads_pytree(dumps_pytree(state))
         assert restored["x"].dtype.name == "bfloat16"
 
+    def test_bf16_numpy_leaf(self) -> None:
+        """HOST bf16 arrays (np.asarray of a bf16 jax array — exactly what
+        DiLoCo fragment backups register in the healing state dict) must
+        serialize: probing ``.data`` on an extension-dtype ndarray raises
+        ValueError, which once leaked out of the shard probe."""
+        host = np.asarray(jnp.arange(6, dtype=jnp.bfloat16))
+        assert isinstance(host, np.ndarray)
+        restored = loads_pytree(dumps_pytree({"backup": [host]}))
+        assert restored["backup"][0].dtype.name == "bfloat16"
+        np.testing.assert_array_equal(
+            restored["backup"][0].astype(np.float32),
+            host.astype(np.float32),
+        )
+
     def test_streaming(self) -> None:
         state = {"big": np.random.default_rng(0).normal(size=100_000)}
         buf = io.BytesIO()

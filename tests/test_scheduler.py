@@ -110,3 +110,207 @@ def test_cli_renders_files(tmp_path) -> None:
     assert len(paths) == 2
     content = open(paths[0]).read()
     assert "TORCHFT_LIGHTHOUSE=lh:1234" in content
+
+
+class _FakeBackend:
+    """Scripted scheduler: records submits, serves states from a queue."""
+
+    def __init__(self):
+        self.submits = []
+        self.states = {}  # job_id -> list of states to serve (last repeats)
+        self._n = 0
+
+    def submit(self, path: str) -> str:
+        self._n += 1
+        job_id = f"job{self._n}"
+        self.submits.append((path, job_id))
+        return job_id
+
+    def state(self, job_id: str) -> str:
+        seq = self.states.get(job_id, ["RUNNING"])
+        return seq.pop(0) if len(seq) > 1 else seq[0]
+
+
+class TestWatcher:
+    """The launch/monitor/relaunch loop, against a scripted backend
+    (the reference's runner does the same against torchx-slurm,
+    ``torchft/examples/slurm/runner.py:120-221``)."""
+
+    def _watcher(self, backend, paths=("a.sbatch", "b.sbatch"), **kw):
+        from torchft_tpu.scheduler import Watcher
+
+        clock = {"t": 0.0}
+        kw.setdefault("clock", lambda: clock["t"])
+        kw.setdefault("sleep", lambda s: None)
+        w = Watcher(list(paths), backend, **kw)
+        return w, clock
+
+    def test_launches_every_group(self) -> None:
+        backend = _FakeBackend()
+        w, _ = self._watcher(backend)
+        w.launch_all()
+        assert [p for p, _ in backend.submits] == ["a.sbatch", "b.sbatch"]
+        assert w.poll_once() == 0  # all RUNNING: nothing pending
+
+    def test_dead_group_relaunched_with_backoff(self) -> None:
+        backend = _FakeBackend()
+        w, clock = self._watcher(backend, initial_backoff_s=5.0)
+        w.launch_all()
+        backend.states["job2"] = ["DEAD"]
+        # death detected: relaunch scheduled, not yet executed (backoff)
+        assert w.poll_once() == 1
+        assert len(backend.submits) == 2
+        clock["t"] = 4.0
+        assert w.poll_once() == 1  # still inside the backoff window
+        assert len(backend.submits) == 2
+        clock["t"] = 5.0
+        w.poll_once()
+        assert len(backend.submits) == 3
+        assert backend.submits[-1][0] == "b.sbatch"  # same group resubmitted
+        assert w.groups[1].relaunches == 1
+        # the healthy group was never touched
+        assert w.groups[0].relaunches == 0
+
+    def test_backoff_doubles_and_caps(self) -> None:
+        backend = _FakeBackend()
+        w, clock = self._watcher(
+            backend, paths=("a.sbatch",), initial_backoff_s=5.0, max_backoff_s=12.0
+        )
+        w.launch_all()
+        expected = [5.0, 10.0, 12.0, 12.0]  # doubling, capped
+        for backoff in expected:
+            jid = w.groups[0].job_id
+            backend.states[jid] = ["DEAD"]
+            w.poll_once()
+            assert w.groups[0].backoff_s == backoff
+            clock["t"] += backoff
+            w.poll_once()
+            assert w.groups[0].job_id is not None
+
+    def test_max_relaunches_gives_up(self) -> None:
+        backend = _FakeBackend()
+        w, clock = self._watcher(
+            backend, paths=("a.sbatch",), initial_backoff_s=0.0, max_relaunches=2
+        )
+        w.launch_all()
+        for _ in range(5):
+            backend.states[w.groups[0].job_id] = ["DEAD"]
+            clock["t"] += 1.0
+            w.poll_once()
+            clock["t"] += 1.0
+            w.poll_once()
+        assert w.groups[0].relaunches == 2  # budget respected
+
+
+def test_watch_against_fake_sbatch(tmp_path) -> None:
+    """End-to-end through the real SlurmCli against fake sbatch/squeue
+    binaries: submit parses --parsable output, a job missing from squeue
+    reads as DEAD and is resubmitted."""
+    from torchft_tpu.scheduler import SlurmCli, Watcher
+
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    count_file = tmp_path / "count"
+    count_file.write_text("0")
+    sbatch = bindir / "sbatch"
+    sbatch.write_text(
+        "#!/bin/bash\n"
+        f'n=$(cat {count_file}); n=$((n+1)); echo $n > {count_file}\n'
+        'echo "$n;cluster"\n'
+    )
+    squeue = bindir / "squeue"
+    # job 1 is never in the queue (immediate death); later jobs run forever
+    squeue.write_text(
+        "#!/bin/bash\n"
+        'while [ "$1" != "-j" ]; do shift; done\n'
+        'if [ "$2" = "1" ]; then exit 0; fi\n'
+        'echo RUNNING\n'
+    )
+    sbatch.chmod(0o755)
+    squeue.chmod(0o755)
+
+    import os
+
+    script = tmp_path / "rg0.sbatch"
+    script.write_text("#!/bin/bash\ntrue\n")
+    old_path = os.environ["PATH"]
+    os.environ["PATH"] = f"{bindir}:{old_path}"
+    try:
+        w = Watcher(
+            [str(script)],
+            SlurmCli(),
+            initial_backoff_s=0.0,
+            sleep=lambda s: None,
+        )
+        w.launch_all()
+        assert w.groups[0].job_id == "1"
+        w.poll_once()  # detects DEAD (job 1 absent from squeue)
+        w.poll_once()  # relaunches
+        assert w.groups[0].job_id == "2"
+        assert w.groups[0].relaunches == 1
+        assert w.poll_once() == 0  # job 2 reads RUNNING: stable
+    finally:
+        os.environ["PATH"] = old_path
+
+
+class _FlakyBackend(_FakeBackend):
+    """First N submits raise (scheduler control plane down)."""
+
+    def __init__(self, fail_first: int):
+        super().__init__()
+        self.fail_first = fail_first
+
+    def submit(self, path: str) -> str:
+        if self.fail_first > 0:
+            self.fail_first -= 1
+            raise RuntimeError("slurmctld unreachable")
+        return super().submit(path)
+
+
+class TestWatcherRobustness:
+    def test_submit_failure_does_not_kill_watch(self) -> None:
+        from torchft_tpu.scheduler import Watcher
+
+        backend = _FlakyBackend(fail_first=1)
+        clock = {"t": 0.0}
+        w = Watcher(
+            ["a.sbatch", "b.sbatch"],
+            backend,
+            initial_backoff_s=5.0,
+            clock=lambda: clock["t"],
+            sleep=lambda s: None,
+        )
+        w.launch_all()  # group 0's submit raises; must not propagate
+        assert w.groups[0].job_id is None
+        assert w.groups[1].job_id is not None
+        clock["t"] = 5.0
+        w.poll_once()  # retried after backoff
+        assert w.groups[0].job_id is not None
+
+    def test_backoff_resets_after_healthy_run(self) -> None:
+        from torchft_tpu.scheduler import Watcher
+
+        backend = _FakeBackend()
+        clock = {"t": 0.0}
+        w = Watcher(
+            ["a.sbatch"],
+            backend,
+            initial_backoff_s=5.0,
+            healthy_reset_s=100.0,
+            clock=lambda: clock["t"],
+            sleep=lambda s: None,
+        )
+        w.launch_all()
+        backend.states[w.groups[0].job_id] = ["DEAD"]
+        w.poll_once()
+        clock["t"] = 5.0
+        w.poll_once()  # relaunch; backoff_s == 5
+        assert w.groups[0].backoff_s == 5.0
+        # incarnation lives well past healthy_reset_s: backoff forgiven
+        clock["t"] = 200.0
+        w.poll_once()
+        assert w.groups[0].backoff_s == 0.0
+        # next death starts from the initial backoff again, not 10s
+        backend.states[w.groups[0].job_id] = ["DEAD"]
+        w.poll_once()
+        assert w.groups[0].backoff_s == 5.0

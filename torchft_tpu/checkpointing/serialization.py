@@ -107,21 +107,29 @@ def _is_multihost_jax_array(x: Any) -> bool:
     )
 
 
+def _is_shard(leaf: Any) -> bool:
+    """jax Shard: carries its array in ``.data`` and is not itself
+    array-like.  The ``__array__`` check must come FIRST: probing ``.data``
+    on a numpy extension-dtype array (e.g. ml_dtypes bfloat16, as produced
+    by ``np.asarray`` of a bf16 jax array — DiLoCo fragment backups) raises
+    ValueError out of ``hasattr``, since buffers cannot carry dtype 'E'."""
+    return not hasattr(leaf, "__array__") and hasattr(leaf, "data")
+
+
 def materialize_leaf(leaf: Any) -> np.ndarray:
     """Host numpy view/copy of a collected leaf (jax arrays device_get
     here, NOT at extraction time — the point of the lazy plan is that only
     one leaf's host copy is ever live during a streaming send)."""
     if isinstance(leaf, np.ndarray):
         return leaf
-    if hasattr(leaf, "data") and not hasattr(leaf, "__array__"):
-        # jax Shard
+    if _is_shard(leaf):
         return np.asarray(leaf.data)
     return np.asarray(leaf)
 
 
 def _leaf_meta(leaf: Any) -> Tuple[str, Tuple[int, ...]]:
     """(dtype name, shape) without materializing the leaf on host."""
-    if hasattr(leaf, "data") and not hasattr(leaf, "__array__"):
+    if _is_shard(leaf):
         leaf = leaf.data
     return np.dtype(leaf.dtype).name, tuple(leaf.shape)
 
@@ -242,7 +250,7 @@ def _snapshot_leaf(leaf: Any) -> Any:
         return leaf.copy()
     import jax.numpy as jnp
 
-    if hasattr(leaf, "data") and not hasattr(leaf, "__array__"):
+    if _is_shard(leaf):
         return jnp.copy(leaf.data)  # jax Shard -> single-device array copy
     return jnp.copy(leaf)
 

@@ -193,6 +193,11 @@ class LighthouseServer:
         # parked quorum waiters (token → member), re-registered atomically
         # when a quorum excludes them — see _tick_locked
         self._parked: Dict[object, QuorumMember] = {}
+        # live client connections, severed at shutdown — a "dead" lighthouse
+        # must look dead to connected managers (kill/restart chaos relies on
+        # it; the reference's process exit severs everything for free)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
         self._sock = create_listener(bind, backlog=512)
         self._port: int = self._sock.getsockname()[1]
@@ -225,6 +230,13 @@ class LighthouseServer:
             self._sock.close()
         except OSError:
             pass
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
         with self._lock:
             self._lock.notify_all()
 
@@ -296,6 +308,11 @@ class LighthouseServer:
             except OSError:
                 return
             configure_server_socket(conn)
+            with self._conns_lock:
+                if self._shutdown:
+                    conn.close()
+                    continue
+                self._conns.add(conn)
             threading.Thread(
                 target=self._handle_conn,
                 args=(conn,),
@@ -341,6 +358,8 @@ class LighthouseServer:
         except (ConnectionError, OSError, WireError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:

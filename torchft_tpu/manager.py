@@ -383,7 +383,13 @@ class Manager:
             quorum_timeout=timeout or self._quorum_timeout,
         )
         if not self._use_async_quorum:
-            self.wait_quorum()
+            # sync quorum (DiLoCo/LocalSGD): a failed quorum RPC funnels to
+            # a False vote like everywhere else, never into the train loop
+            try:
+                self.wait_quorum()
+            except Exception as e:  # noqa: BLE001
+                self.report_error(e)
+                return
             if self._healing:
                 # heal eagerly so the forward pass runs on good state
                 self._apply_pending_state_dict()
@@ -578,7 +584,11 @@ class Manager:
         (single-member communicator, this replica fully participating) —
         callers may then skip device↔host gradient movement entirely, the
         analog of a world-size-1 NCCL allreduce being free."""
-        self.wait_quorum()
+        try:
+            self.wait_quorum()
+        except Exception as e:  # noqa: BLE001 — funnel, never raise
+            self.report_error(e)
+            return False
         return (
             self._comm.size() <= 1
             and self.num_participants() == 1
@@ -609,7 +619,14 @@ class Manager:
         if self.errored():
             return DummyWork(data)
 
-        self.wait_quorum()
+        # a failed quorum funnels like any collective error: the input rides
+        # through unchanged and the vote discards the step — errors must
+        # never propagate into the train loop (``manager.py:487-493``)
+        try:
+            self.wait_quorum()
+        except Exception as e:  # noqa: BLE001
+            self.report_error(e)
+            return DummyWork(data)
         num_participants = self.num_participants()
 
         if not self.is_participating():
@@ -668,7 +685,11 @@ class Manager:
         if self.errored():
             return DummyWork(_own_value())
 
-        self.wait_quorum()
+        try:
+            self.wait_quorum()
+        except Exception as e:  # noqa: BLE001 — funnel, never raise
+            self.report_error(e)
+            return DummyWork(_own_value())
         num_participants = self.num_participants()
         q_in, s_in = q, scales
         if not self.is_participating():

@@ -189,6 +189,248 @@ def submit(backend: str, paths: List[str]) -> None:
         logger.info("submitted %s", path)
 
 
+# The reference templates protocol timeouts into every replica-group job
+# (``torchft/examples/slurm/runner.py:83-89``): quorum timeout must dwarf the
+# step time (it is the rejoin window), per-op timeout must stay under it so a
+# wedged collective aborts before the quorum gives up on the group.
+TIMEOUT_ENV_TEMPLATE: Dict[str, str] = {
+    "TORCHFT_QUORUM_TIMEOUT_SEC": "900",
+    "TORCHFT_TIMEOUT_SEC": "600",
+    "TORCHFT_CONNECT_TIMEOUT_SEC": "60",
+}
+
+
+class SlurmCli:
+    """Thin sbatch/squeue shim (injectable for tests)."""
+
+    def submit(self, path: str) -> str:
+        out = subprocess.run(
+            ["sbatch", "--parsable", path],
+            check=True,
+            capture_output=True,
+            text=True,
+        ).stdout.strip()
+        return out.split(";")[0]  # "<jobid>[;cluster]"
+
+    def state(self, job_id: str) -> str:
+        """"RUNNING"/"PENDING"/... or "DEAD" when the queue no longer knows
+        the job (finished, failed, or preempted past requeue)."""
+        proc = subprocess.run(
+            ["squeue", "-h", "-j", job_id, "-o", "%T"],
+            capture_output=True,
+            text=True,
+        )
+        state = proc.stdout.strip().splitlines()
+        if proc.returncode != 0 or not state:
+            return "DEAD"
+        return state[0]
+
+
+class GkeCli:
+    """kubectl shim: job name == manifest metadata.name (the render names
+    them deterministically)."""
+
+    def __init__(self, namespace: str = "default") -> None:
+        self.namespace = namespace
+
+    def submit(self, path: str) -> str:
+        name = os.path.splitext(os.path.basename(path))[0]
+        # delete-then-apply: a completed/failed Job of the same name blocks
+        # resubmission (Jobs are immutable)
+        subprocess.run(
+            [
+                "kubectl", "delete", "job", name,
+                "-n", self.namespace, "--ignore-not-found",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        subprocess.run(
+            ["kubectl", "apply", "-f", path], check=True, capture_output=True
+        )
+        return name
+
+    def state(self, job_id: str) -> str:
+        proc = subprocess.run(
+            [
+                "kubectl", "get", "job", job_id,
+                "-n", self.namespace,
+                "-o",
+                "jsonpath={.status.active},{.status.failed},{.status.succeeded}",
+            ],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            return "DEAD"
+        parts = (proc.stdout.strip().split(",") + ["", ""])[:3]
+        active, failed, succeeded = parts
+        if active not in ("", "0"):
+            return "RUNNING"
+        # a finished Job — failed OR exited 0 (e.g. node drain SIGTERM) —
+        # reads DEAD either way: FT training groups run until the whole job
+        # ends, so "completed" mid-watch means the group left the fleet
+        # (same semantics as SlurmCli, where a job absent from squeue is
+        # DEAD regardless of exit code)
+        if failed not in ("", "0") or succeeded not in ("", "0"):
+            return "DEAD"
+        return "PENDING"
+
+
+@dataclass
+class _WatchedGroup:
+    rid: int
+    path: str
+    job_id: Optional[str] = None
+    relaunches: int = 0
+    backoff_s: float = 0.0
+    not_before: float = 0.0  # monotonic gate for the next (re)launch
+    launched_at: float = 0.0  # when the current incarnation was submitted
+    gave_up: bool = False  # out of relaunch budget; no longer polled
+
+
+class Watcher:
+    """Launch + monitor + relaunch replica-group jobs — the other half of
+    the reference's SLURM runner (``torchft/examples/slurm/runner.py:120-221``,
+    Monarch does the same actor-style).  Each group is an independent
+    failure domain: a dead job is resubmitted with per-group exponential
+    backoff while the surviving groups keep training; the rejoined group
+    heals from a live peer at its next quorum.
+
+    ``backend`` needs only ``submit(path) -> job_id`` and
+    ``state(job_id) -> str`` ("DEAD" meaning gone); tests inject fakes,
+    deployments use :class:`SlurmCli` / :class:`GkeCli`.
+    """
+
+    def __init__(
+        self,
+        paths: List[str],
+        backend,
+        poll_s: float = 10.0,
+        initial_backoff_s: float = 5.0,
+        max_backoff_s: float = 300.0,
+        max_relaunches: Optional[int] = None,
+        healthy_reset_s: float = 600.0,
+        clock=None,
+        sleep=None,
+    ) -> None:
+        import time
+
+        self._groups = [
+            _WatchedGroup(rid=i, path=p) for i, p in enumerate(paths)
+        ]
+        self._backend = backend
+        self._poll_s = poll_s
+        self._initial_backoff_s = initial_backoff_s
+        self._max_backoff_s = max_backoff_s
+        self._max_relaunches = max_relaunches
+        self._healthy_reset_s = healthy_reset_s
+        self._clock = clock or time.monotonic
+        self._sleep = sleep or time.sleep
+        self._stop = False
+
+    @property
+    def groups(self) -> List[_WatchedGroup]:
+        return self._groups
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def _submit(self, g: _WatchedGroup) -> bool:
+        """Submit one group; a transient scheduler failure (slurmctld
+        failover, apiserver blip) must never kill the watch loop — the
+        group retries after its backoff."""
+        try:
+            g.job_id = self._backend.submit(g.path)
+        except Exception as e:  # noqa: BLE001
+            g.backoff_s = min(
+                self._max_backoff_s,
+                g.backoff_s * 2 if g.backoff_s else self._initial_backoff_s,
+            )
+            g.not_before = self._clock() + g.backoff_s
+            logger.warning(
+                "replica group %d submit failed (%s); retrying in %.0fs",
+                g.rid,
+                e,
+                g.backoff_s,
+            )
+            return False
+        g.launched_at = self._clock()
+        return True
+
+    def launch_all(self) -> None:
+        for g in self._groups:
+            if self._submit(g):
+                logger.info(
+                    "replica group %d submitted as %s", g.rid, g.job_id
+                )
+
+    def poll_once(self) -> int:
+        """One monitoring pass; returns how many groups are currently being
+        relaunched/backed off (0 = everything alive or given up)."""
+        pending = 0
+        now = self._clock()
+        for g in self._groups:
+            if g.gave_up:
+                continue
+            if g.job_id is not None:
+                if self._backend.state(g.job_id) != "DEAD":
+                    # an incarnation that survived a long stretch earns a
+                    # fresh backoff (crash loops keep ratcheting; a job
+                    # dying after days must not wait minutes to respawn)
+                    if (
+                        g.backoff_s
+                        and now - g.launched_at > self._healthy_reset_s
+                    ):
+                        g.backoff_s = 0.0
+                    continue
+                # job vanished: schedule a relaunch with backoff
+                if (
+                    self._max_relaunches is not None
+                    and g.relaunches >= self._max_relaunches
+                ):
+                    logger.error(
+                        "replica group %d (%s) dead and out of relaunches; "
+                        "giving up on it",
+                        g.rid,
+                        g.job_id,
+                    )
+                    g.job_id = None
+                    g.gave_up = True
+                    continue
+                g.backoff_s = min(
+                    self._max_backoff_s,
+                    g.backoff_s * 2 if g.backoff_s else self._initial_backoff_s,
+                )
+                g.not_before = now + g.backoff_s
+                logger.warning(
+                    "replica group %d (%s) died; relaunching in %.0fs",
+                    g.rid,
+                    g.job_id,
+                    g.backoff_s,
+                )
+                g.job_id = None
+            if g.job_id is None:
+                pending += 1
+                if now >= g.not_before and self._submit(g):
+                    g.relaunches += 1
+                    logger.info(
+                        "replica group %d relaunched as %s (restart %d)",
+                        g.rid,
+                        g.job_id,
+                        g.relaunches,
+                    )
+        return pending
+
+    def run(self) -> None:
+        """Block, monitoring until :meth:`stop` (deployments run this in the
+        foreground the way the reference runner does)."""
+        self.launch_all()
+        while not self._stop:
+            self.poll_once()
+            self._sleep(self._poll_s)
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     parser = argparse.ArgumentParser(
         "torchft_tpu.scheduler",
@@ -217,6 +459,24 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="extra env var for every replica group (repeatable)",
     )
     parser.add_argument("--submit", action="store_true")
+    parser.add_argument(
+        "--watch",
+        action="store_true",
+        help="after submitting, monitor job state and relaunch dead replica "
+        "groups with backoff (implies --submit)",
+    )
+    parser.add_argument("--poll-s", type=float, default=10.0)
+    parser.add_argument(
+        "--max-relaunches",
+        type=int,
+        default=None,
+        help="per-group relaunch budget for --watch (default: unlimited)",
+    )
+    parser.add_argument(
+        "--no-timeout-env",
+        action="store_true",
+        help="skip templating the TORCHFT_*_TIMEOUT_SEC doctrine into jobs",
+    )
     # split at "--" before argparse: REMAINDER after a positional swallows
     # the option flags too
     raw = list(sys.argv[1:] if argv is None else argv)
@@ -230,7 +490,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     if not cmd:
         parser.error("training command required after --")
 
-    env = {}
+    env = {} if args.no_timeout_env else dict(TIMEOUT_ENV_TEMPLATE)
     for kv in args.env:
         k, _, v = kv.partition("=")
         env[k] = v
@@ -257,7 +517,24 @@ def main(argv: Optional[List[str]] = None) -> None:
     paths = write_specs(rendered, args.out_dir)
     for p in paths:
         print(p)
-    if args.submit:
+    if args.watch:
+        backend = (
+            SlurmCli() if args.backend == "slurm" else GkeCli(args.namespace)
+        )
+        tool = "sbatch" if args.backend == "slurm" else "kubectl"
+        if shutil.which(tool) is None:
+            raise RuntimeError(f"--watch needs {tool} on PATH")
+        watcher = Watcher(
+            paths,
+            backend,
+            poll_s=args.poll_s,
+            max_relaunches=args.max_relaunches,
+        )
+        try:
+            watcher.run()
+        except KeyboardInterrupt:
+            watcher.stop()
+    elif args.submit:
         submit(args.backend, paths)
 
 

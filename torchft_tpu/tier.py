@@ -1,0 +1,93 @@
+"""Runtime tier selection: C++ native plane vs pure-Python fallback.
+
+The C++ runtime (``native/libtpuft.so``) is the production tier: poll-driven
+duplex TCP collectives, native lighthouse/manager servers speaking the same
+framed wire protocol as their Python twins (``tests/test_native.py`` proves
+cross-tier interop).  The Python tier exists so the framework runs anywhere
+the shared library doesn't build.  This mirrors the reference, whose benched
+production path is NCCL while Gloo is the portable fallback
+(``torchft/process_group.py:643-891``).
+
+``TORCHFT_TIER`` selects explicitly: ``cpp`` | ``python`` | ``auto``
+(default — cpp whenever the library loads).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("torchft_tpu.tier")
+
+TIER_ENV = "TORCHFT_TIER"
+
+
+def default_tier() -> str:
+    """Resolve the active tier name ("cpp" or "python")."""
+    env = os.environ.get(TIER_ENV, "auto").lower()
+    if env in ("cpp", "python"):
+        return env
+    if env not in ("", "auto"):
+        logger.warning("unknown %s=%r; using auto", TIER_ENV, env)
+    try:
+        from torchft_tpu import native
+
+        return "cpp" if native.available() else "python"
+    except Exception:  # noqa: BLE001 — a broken build falls back, not crashes
+        return "python"
+
+
+def make_communicator(timeout_s: float = 60.0, tier: Optional[str] = None):
+    """Data-plane communicator for the active tier."""
+    tier = tier or default_tier()
+    if tier == "cpp":
+        from torchft_tpu.native import CppCommunicator
+
+        return CppCommunicator(timeout_s=timeout_s)
+    from torchft_tpu.communicator import TCPCommunicator
+
+    return TCPCommunicator(timeout_s=timeout_s)
+
+
+def make_lighthouse(
+    bind: str = "0.0.0.0:0",
+    min_replicas: int = 1,
+    join_timeout_ms: int = 100,
+    quorum_tick_ms: int = 100,
+    heartbeat_timeout_ms: int = 5_000,
+    tier: Optional[str] = None,
+):
+    """Lighthouse server for the active tier (same ctor surface both ways).
+
+    The Python lighthouse additionally serves the web dashboard; deployments
+    that want both the C++ control plane and the dashboard can front the C++
+    server with ``lighthouse.py``'s HTTP handler.
+    """
+    tier = tier or default_tier()
+    kwargs = dict(
+        bind=bind,
+        min_replicas=min_replicas,
+        join_timeout_ms=join_timeout_ms,
+        quorum_tick_ms=quorum_tick_ms,
+        heartbeat_timeout_ms=heartbeat_timeout_ms,
+    )
+    if tier == "cpp":
+        from torchft_tpu.native import CppLighthouseServer
+
+        return CppLighthouseServer(**kwargs)
+    from torchft_tpu.lighthouse import LighthouseServer
+
+    return LighthouseServer(**kwargs)
+
+
+def manager_server_cls(tier: Optional[str] = None):
+    """The ``server_cls`` to hand :class:`torchft_tpu.manager.Manager`."""
+    tier = tier or default_tier()
+    if tier == "cpp":
+        from torchft_tpu.native import CppManagerServer
+
+        return CppManagerServer
+    from torchft_tpu.manager_server import ManagerServer
+
+    return ManagerServer
