@@ -138,6 +138,10 @@ def _sizes(on_cpu: bool) -> Dict[str, int]:
         "fleet_seq": env_int("TPUFT_BENCH_FLEET_SEQ", 256, 512),
         "fleet_batch": env_int("TPUFT_BENCH_FLEET_BATCH", 4, 8),
         "fleet_head_dim": 64,
+        # warm standby for killable replicas: a parked pre-initialized spare
+        # is promoted on kill, cutting heal-in from cold-start seconds to
+        # join+transfer seconds (0 measures the cold path instead)
+        "standby": env_int("TPUFT_BENCH_STANDBY", 1, 1),
         # phase D (DiLoCo): inner steps + streaming-fragment schedule
         "diloco_steps": env_int("TPUFT_BENCH_DILOCO_STEPS", 24, 80),
         "diloco_sync_every": env_int("TPUFT_BENCH_DILOCO_SYNC", 8, 8),
@@ -250,6 +254,21 @@ def worker_main() -> None:
         )
     grad_step = jax.jit(jax.value_and_grad(model.loss))
     ev.phase("model_ready")
+
+    gate = os.environ.get("TPUFT_STANDBY_GATE")
+    if gate:
+        # warm standby (launcher promotes us on the active twin's death):
+        # pay the compile + first-execution cost NOW, then park.  A standby
+        # must not touch the quorum while parked — the Manager is only
+        # constructed after promotion.
+        _loss, grads = grad_step(holder["params"], batches[0])
+        _sync(grads)
+        ev.phase("standby_parked")
+        while not os.path.exists(gate) and not os.path.exists(stop_path):
+            time.sleep(0.05)
+        if os.path.exists(stop_path):
+            return
+        ev.phase("standby_promoted")
 
     tier = tier_mod.default_tier()
     manager = Manager(
@@ -418,11 +437,14 @@ def run_fleet(
     }
     for k in ("dim", "layers", "seq", "batch", "head_dim"):
         env[f"TPUFT_BENCH_{k.upper()}"] = str(sizes[f"fleet_{k}"])
+    standby = bool(sizes.get("standby")) and kill_every > 0
     specs = [
         ReplicaSpec(
             replica_group_id=i,
             cmd=[sys.executable, os.path.abspath(__file__), "--worker"],
             env=dict(env),
+            # spares only behind killable replicas (0 is the anchor)
+            standby=standby and i != 0,
         )
         for i in range(replicas)
     ]
@@ -689,6 +711,9 @@ def _heal_breakdown(
         ("proc_start", "respawn_s"),
         ("jax_ready", "jax_init_s"),
         ("model_ready", "model_build_s"),
+        # warm-standby takeover: detection + gate release (the phases above
+        # are absent — the spare paid them before the kill)
+        ("standby_promoted", "promote_s"),
         ("manager_ready", "manager_s"),
     ):
         if name in t:
@@ -770,6 +795,10 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
         f"fault-free: {faultfree_s*1e3:.1f} ms/step, {faultfree_tps:,.0f} tok/s",
         file=sys.stderr,
     )
+    # free the baseline's params+optimizer copies BEFORE the FT stack
+    # allocates its own — at ~1B params two live copies OOM a single chip
+    del ff_params, opt_state, grads
+    _sync(params)
 
     # full FT stack, ws=1, on the production tier
     tier = tier_mod.default_tier()
@@ -894,6 +923,7 @@ def main() -> None:
             "fleet_steps": sizes["fleet_steps"],
             "kill_every": sizes["kill_every"],
             "replicas": replicas,
+            "standby": bool(sizes.get("standby")),
             "kills": faulted.get("kills", 0),
             "faultfree_fleet": faultfree,
             "faulted_fleet": faulted,

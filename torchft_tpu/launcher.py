@@ -26,10 +26,11 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger("torchft_tpu.launcher")
 
@@ -41,6 +42,12 @@ class ReplicaSpec:
     env: Dict[str, str] = field(default_factory=dict)
     # when set, the group's stdout/stderr append here (survives restarts)
     log_path: Optional[str] = None
+    # warm standby: keep a pre-initialized spare process parked behind the
+    # active one and promote it on death (see ReplicaSupervisor)
+    standby: bool = False
+
+
+STANDBY_GATE_ENV = "TPUFT_STANDBY_GATE"
 
 
 class ReplicaSupervisor:
@@ -48,6 +55,20 @@ class ReplicaSupervisor:
 
     ``max_restarts`` bounds per-group restarts (None = unlimited), matching
     the respawn loop of the reference's SLURM/Monarch orchestrators.
+
+    **Warm standby** (``ReplicaSpec.standby=True``): alongside the active
+    process, a spare runs the same command with ``TPUFT_STANDBY_GATE=<file>``
+    in its env.  A standby-aware worker does all its expensive
+    initialization (python boot, jax/TPU backend dial, model build,
+    compilation) and then parks, polling for the gate file; it must NOT
+    join the quorum while parked.  When the active process dies, the
+    supervisor *promotes* the standby by creating its gate file — the spare
+    joins the quorum and heals within a step or two instead of paying tens
+    of seconds of cold start — and pre-warms a fresh standby behind it.
+    This is the process-level analog of the reference's quorum-level spares
+    (``WorldSizeMode.FIXED_WITH_SPARES``, ``torchft/manager.py:123-139``).
+    Workers that ignore the env var simply run twice, so only enable it for
+    standby-aware commands.
     """
 
     def __init__(
@@ -62,16 +83,25 @@ class ReplicaSupervisor:
         self._max_restarts = max_restarts
         self._restart_delay_s = restart_delay_s
         self._procs: Dict[int, subprocess.Popen] = {}
+        self._standbys: Dict[int, Tuple[subprocess.Popen, str]] = {}
         self._restarts: Dict[int, int] = {}
+        self._gate_dir: Optional[str] = None
+        self._gate_seq = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
 
-    def _spawn(self, spec: ReplicaSpec) -> subprocess.Popen:
+    def _spawn(
+        self, spec: ReplicaSpec, standby_gate: Optional[str] = None
+    ) -> subprocess.Popen:
         env = dict(os.environ)
         env.update(spec.env)
         env["TORCHFT_LIGHTHOUSE"] = self._lighthouse_addr
         env["REPLICA_GROUP_ID"] = str(spec.replica_group_id)
         env["NUM_REPLICA_GROUPS"] = str(len(self._specs))
+        if standby_gate is not None:
+            env[STANDBY_GATE_ENV] = standby_gate
+        else:
+            env.pop(STANDBY_GATE_ENV, None)
         logger.info(
             "launching replica group %d: %s", spec.replica_group_id, spec.cmd
         )
@@ -98,6 +128,16 @@ class ReplicaSupervisor:
             if log is not None:
                 log.close()  # the child holds its own fd
 
+    def _new_standby(self, spec: ReplicaSpec) -> Tuple[subprocess.Popen, str]:
+        if self._gate_dir is None:
+            self._gate_dir = tempfile.mkdtemp(prefix="tpuft_standby_")
+        self._gate_seq += 1
+        gate = os.path.join(
+            self._gate_dir,
+            f"gate_{spec.replica_group_id}_{self._gate_seq}",
+        )
+        return self._spawn(spec, standby_gate=gate), gate
+
     def run(self) -> int:
         """Run until every group exits cleanly (rc 0) or is out of restarts.
         Returns the worst exit code."""
@@ -105,6 +145,10 @@ class ReplicaSupervisor:
             for spec in self._specs:
                 self._procs[spec.replica_group_id] = self._spawn(spec)
                 self._restarts[spec.replica_group_id] = 0
+                if spec.standby:
+                    self._standbys[spec.replica_group_id] = self._new_standby(
+                        spec
+                    )
 
         worst_rc = 0
         alive = {spec.replica_group_id for spec in self._specs}
@@ -114,6 +158,18 @@ class ReplicaSupervisor:
                 gid = spec.replica_group_id
                 if gid not in alive:
                     continue
+                # a standby that died while parked is replaced quietly (it
+                # was never part of the fleet)
+                if spec.standby:
+                    with self._lock:
+                        sb = self._standbys.get(gid)
+                        if sb is not None and sb[0].poll() is not None:
+                            logger.warning(
+                                "standby for group %d died while parked; "
+                                "re-warming",
+                                gid,
+                            )
+                            self._standbys[gid] = self._new_standby(spec)
                 proc = self._procs[gid]
                 rc = proc.poll()
                 if rc is None:
@@ -138,6 +194,29 @@ class ReplicaSupervisor:
                     # failed group must never read as success
                     worst_rc = max(worst_rc, abs(rc) or 1)
                     alive.discard(gid)
+                    continue
+                promoted = False
+                with self._lock:
+                    sb = self._standbys.pop(gid, None)
+                    if sb is not None and sb[0].poll() is None:
+                        # promote: the gate file releases the parked spare,
+                        # which joins the quorum already warm — no restart
+                        # delay, no cold start
+                        with open(sb[1], "w"):
+                            pass
+                        self._procs[gid] = sb[0]
+                        promoted = True
+                if promoted:
+                    logger.warning(
+                        "replica group %d exited rc=%d; promoted warm "
+                        "standby (%d)",
+                        gid,
+                        rc,
+                        self._restarts[gid],
+                    )
+                    with self._lock:
+                        if spec.standby and not self._stop.is_set():
+                            self._standbys[gid] = self._new_standby(spec)
                     continue
                 logger.warning(
                     "replica group %d exited rc=%d; restarting (%d)",
@@ -169,6 +248,10 @@ class ReplicaSupervisor:
             for proc in self._procs.values():
                 if proc.poll() is None:
                     proc.terminate()
+            for proc, _gate in self._standbys.values():
+                if proc.poll() is None:
+                    proc.terminate()
+            self._standbys.clear()
 
 
 def main(argv: Optional[List[str]] = None) -> None:

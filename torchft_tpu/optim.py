@@ -96,5 +96,11 @@ class OptimizerWrapper:
                 updates, new_state = self.tx.update(grads, opt_state, params)
                 return optax.apply_updates(params, updates), new_state
 
-            self._cached_update = jax.jit(_upd)
+            # donate params + opt_state: the update replaces them, and NOT
+            # donating doubles resident params+optimizer HBM at the peak of
+            # every step — the difference between a ~1B model fitting one
+            # chip or OOMing.  Callers must treat the inputs as consumed
+            # (step() swaps the holder entries; step_fn returns the new
+            # pytrees); grads stay readable.
+            self._cached_update = jax.jit(_upd, donate_argnums=(0, 1))
         return self._cached_update(params, opt_state, grads)
