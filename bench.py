@@ -86,16 +86,59 @@ def _peak_tflops(device) -> Optional[float]:
 
 def _probe_backend(timeout_s: float = 180.0) -> bool:
     """Check (in a subprocess, so a wedged TPU tunnel can't hang us) that
-    the default jax backend can actually initialize."""
+    the default jax backend can actually initialize AND execute: the probe
+    round-trips one tiny computation to host, because under the axon
+    tunnel ``jax.devices()`` can succeed while execution wedges."""
     try:
         probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [
+                sys.executable,
+                "-c",
+                "import jax, numpy; "
+                "x = jax.numpy.ones((8, 8)); "
+                "assert numpy.asarray(x @ x)[0, 0] == 8.0",
+            ],
             timeout=timeout_s,
             capture_output=True,
         )
         return probe.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+def _probe_backend_with_retries() -> bool:
+    """The TPU tunnel wedges *transiently*; a single failed probe must not
+    silently downgrade the whole bench to CPU (round 3's artifact lost its
+    TPU numbers to exactly that).  Retry within a bounded window, then fall
+    back LOUDLY."""
+    window_s = float(os.environ.get("TPUFT_BENCH_PROBE_WINDOW_S", "900"))
+    probe_timeout_s = float(
+        os.environ.get("TPUFT_BENCH_PROBE_TIMEOUT_S", "180")
+    )
+    deadline = time.time() + window_s
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.time()
+        if _probe_backend(probe_timeout_s):
+            if attempt > 1:
+                print(
+                    f"bench: backend probe succeeded on attempt {attempt}",
+                    file=sys.stderr,
+                )
+            return True
+        if time.time() >= deadline:
+            return False
+        wait = min(30.0, max(5.0, deadline - time.time()))
+        print(
+            f"bench: backend probe attempt {attempt} failed after "
+            f"{time.time() - t0:.0f}s; retrying in {wait:.0f}s "
+            f"({deadline - time.time():.0f}s left in retry window)",
+            file=sys.stderr,
+        )
+        if time.time() + wait >= deadline:
+            wait = max(0.0, deadline - time.time())
+        time.sleep(wait)
 
 
 def _configure_jax(platform: Optional[str]) -> None:
@@ -193,18 +236,22 @@ def _build_model(sizes: Dict[str, int], fleet: bool = False):
 
 class _EventLog:
     """Line-buffered JSONL event/phase log; survives SIGKILL mid-line (the
-    reader skips torn lines)."""
+    reader skips torn lines).  Every record carries the writer's pid: a
+    replica group's log interleaves multiple process incarnations (active,
+    killed, promoted standby, re-warmed spare), and heal attribution must
+    only read the incarnation that actually rejoined."""
 
     def __init__(self, path: str) -> None:
         self._f = open(path, "a", buffering=1)
+        self._pid = os.getpid()
 
     def phase(self, name: str, **extra: Any) -> None:
-        rec = {"phase": name, "ts": time.time()}
+        rec = {"phase": name, "ts": time.time(), "pid": self._pid}
         rec.update(extra)
         self._f.write(json.dumps(rec) + "\n")
 
     def step(self, step: int, **extra: Any) -> None:
-        rec = {"step": step, "ts": time.time()}
+        rec = {"step": step, "ts": time.time(), "pid": self._pid}
         rec.update(extra)
         self._f.write(json.dumps(rec) + "\n")
 
@@ -422,7 +469,11 @@ def run_fleet(
     lighthouse = tier_mod.make_lighthouse(
         bind="127.0.0.1:0",
         min_replicas=1,
-        join_timeout_ms=3000,
+        # the join window is pure heal-in latency for a rejoining victim
+        # (its quorum RPC parks for the full window when membership grows);
+        # 1 s is ample straggler slack for localhost RPC while keeping the
+        # standby-promotion heal in the join+transfer regime
+        join_timeout_ms=int(os.environ.get("TPUFT_BENCH_JOIN_MS", "1000")),
         quorum_tick_ms=50,
         tier=tier,
     )
@@ -631,9 +682,19 @@ def _fleet_metrics(
     breakdowns: List[Dict[str, float]] = []
     by_victim: Dict[int, List[float]] = {}
     for kill in kills:
-        vic = evs[kill["victim"]]
-        back = [(s, t) for (s, t) in vic if t > kill["ts"]]
-        rejoin_ts = back[0][1] if back else None
+        # the rejoin record (first committed step after the kill) — read it
+        # once so ts and the rejoining incarnation's pid come from the same
+        # event (matching them up later by float ts equality would be
+        # fragile)
+        rejoin_rec = next(
+            (
+                r
+                for r in records[kill["victim"]]
+                if "step" in r and r["ts"] > kill["ts"]
+            ),
+            None,
+        )
+        rejoin_ts = rejoin_rec["ts"] if rejoin_rec else None
         if rejoin_ts is not None:
             heal_secs.append(rejoin_ts - kill["ts"])
             by_victim.setdefault(kill["victim"], []).append(
@@ -647,7 +708,10 @@ def _fleet_metrics(
                 max(0, anchor_at_rejoin - kill["survivor_step"])
             )
             bd = _heal_breakdown(
-                records[kill["victim"]], kill["ts"], rejoin_ts
+                records[kill["victim"]],
+                kill["ts"],
+                rejoin_ts,
+                rejoin_rec.get("pid"),
             )
             if bd:
                 breakdowns.append(bd)
@@ -671,13 +735,33 @@ def _fleet_metrics(
             str(v): [round(h, 1) for h in hs] for v, hs in by_victim.items()
         }
     if breakdowns:
-        keys = sorted({k for bd in breakdowns for k in bd})
-        result["heal_breakdown"] = {
+        numeric_keys = sorted(
+            {
+                k
+                for bd in breakdowns
+                for k, v in bd.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+        )
+        agg: Dict[str, Any] = {
+            # mean over the kills in which the phase occurred (a key absent
+            # from a breakdown means that heal path skipped the phase, not
+            # that it took 0 s — cold respawns have no promote_s and
+            # standby promotions have no respawn_s)
             k: round(
-                sum(bd.get(k, 0.0) for bd in breakdowns) / len(breakdowns), 2
+                sum(bd[k] for bd in breakdowns if k in bd)
+                / sum(1 for bd in breakdowns if k in bd),
+                2,
             )
-            for k in keys
+            for k in numeric_keys
         }
+        agg["paths"] = {
+            p: sum(1 for bd in breakdowns if bd.get("path") == p)
+            for p in {bd.get("path") for bd in breakdowns}
+        }
+        agg["all_sane"] = all(bd.get("sane") for bd in breakdowns)
+        result["heal_breakdown"] = agg
+        result["heal_breakdowns"] = breakdowns
     if overheads:
         result["overhead_per_kill_s"] = round(
             sum(overheads) / len(overheads), 3
@@ -694,25 +778,36 @@ def _heal_breakdown(
     victim_records: List[Dict[str, Any]],
     kill_ts: float,
     rejoin_ts: float,
-) -> Dict[str, float]:
+    rejoin_pid: Optional[int],
+) -> Dict[str, Any]:
     """Attribute one victim rejoin to phases, from its phase log:
     respawn (supervisor delay + python boot), jax_init (backend/tunnel
-    dial), model_build (init + device_put + trace), manager (ctor + server
-    + store), join_heal (quorum rpc incl. join window, rendezvous,
-    checkpoint transfer — sub-attributed from Manager timings), first_step
-    (compile + step math up to the first committed event)."""
+    dial), model_build (init + device_put + trace), promote (death
+    detection + gate release, warm-standby path), manager (ctor + server
+    + store), join_to_first_commit (quorum rpc incl. join window,
+    rendezvous, checkpoint transfer — sub-attributed from Manager timings,
+    plus first-step compile).
+
+    Only the **rejoining incarnation's** phases count (matched by pid): the
+    group's log interleaves the killed process, the promoted standby, and
+    the fresh spare re-warmed behind it — the spare's boot phases land
+    inside the kill→rejoin window but are off the heal path (round-3
+    artifact had ``promote_s = -5.44`` from exactly this mixing)."""
     phases = [
-        p for p in _phases_of(victim_records) if kill_ts < p["ts"] <= rejoin_ts
+        p
+        for p in _phases_of(victim_records)
+        if kill_ts < p["ts"] <= rejoin_ts
+        and (rejoin_pid is None or p.get("pid") == rejoin_pid)
     ]
     t = {p["phase"]: p for p in phases}
-    out: Dict[str, float] = {}
+    out: Dict[str, Any] = {}
     prev = kill_ts
     for name, key in (
         ("proc_start", "respawn_s"),
         ("jax_ready", "jax_init_s"),
         ("model_ready", "model_build_s"),
-        # warm-standby takeover: detection + gate release (the phases above
-        # are absent — the spare paid them before the kill)
+        # warm-standby takeover: the phases above are absent — the spare
+        # paid them before the kill, while parked
         ("standby_promoted", "promote_s"),
         ("manager_ready", "manager_s"),
     ):
@@ -720,11 +815,24 @@ def _heal_breakdown(
             out[key] = t[name]["ts"] - prev
             prev = t[name]["ts"]
     out["join_to_first_commit_s"] = rejoin_ts - prev
+    # trust signal: every phase must be non-negative (the walk chains
+    # timestamps of ONE process, so a negative means cross-incarnation
+    # mixing), and the rejoiner must have logged manager_ready — it cannot
+    # have committed a step without constructing a Manager, so its absence
+    # means the pid filter matched the wrong (or no) incarnation
+    numeric = [v for v in out.values() if isinstance(v, float)]
+    out["path"] = "standby" if "standby_promoted" in t else "cold"
+    out["sane"] = bool(
+        all(v >= -1e-6 for v in numeric) and "manager_ready" in t
+    )
     fc = t.get("first_commit")
     if fc and isinstance(fc.get("timings"), dict):
         for k, v in fc["timings"].items():
             out[f"quorum_{k}"] = v
-    return {k: round(v, 3) for k, v in out.items()}
+    return {
+        k: (round(v, 3) if isinstance(v, float) else v)
+        for k, v in out.items()
+    }
 
 
 # --------------------------------------------------------------------------
@@ -878,12 +986,38 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
     return out
 
 
+_PARTIAL: Dict[str, Any] = {}
+_PARTIAL_PATH = os.path.join(REPO, "bench_out.json")
+
+
+def _emit_partial(**updates: Any) -> None:
+    """Stream results to ``bench_out.json`` as each phase completes, so a
+    driver that captures only the output tail — or a late-phase hang — can
+    never lose the already-measured numbers (round 3 lost the MFU head to
+    exactly that truncation)."""
+    _PARTIAL.update(updates)
+    _PARTIAL["partial_ts"] = round(time.time(), 1)
+    try:
+        tmp = _PARTIAL_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_PARTIAL, f, indent=1)
+        os.replace(tmp, _PARTIAL_PATH)
+    except OSError as e:  # a broken sink must not kill the bench
+        print(f"bench: cannot write {_PARTIAL_PATH}: {e}", file=sys.stderr)
+
+
 def main() -> None:
     platform = os.environ.get("TPUFT_BENCH_PLATFORM")
-    if not platform and not _probe_backend():
+    fallback = False
+    if not platform and not _probe_backend_with_retries():
+        fallback = True
+        banner = "!" * 72
         print(
-            "bench: default backend failed to initialize (wedged TPU tunnel?); "
-            "falling back to cpu",
+            f"{banner}\n"
+            "bench: CPU FALLBACK — the default jax backend (TPU tunnel) "
+            "failed to\ninitialize within the retry window.  EVERY NUMBER "
+            "BELOW IS A CPU\nMEASUREMENT, NOT TPU.\n"
+            f"{banner}",
             file=sys.stderr,
         )
         platform = "cpu"
@@ -893,8 +1027,14 @@ def main() -> None:
 
     on_cpu = jax.default_backend() == "cpu"
     sizes = _sizes(on_cpu)
+    _emit_partial(
+        platform=jax.default_backend(),
+        cpu_fallback=fallback,
+        sizes={k: v for k, v in sizes.items()},
+    )
 
     single = run_single(sizes)
+    _emit_partial(single=single)
 
     faults: Dict[str, Any] = {}
     diloco: Dict[str, Any] = {}
@@ -910,6 +1050,7 @@ def main() -> None:
             replicas=replicas,
         )
         print(f"bench: fleet fault-free {faultfree}", file=sys.stderr)
+        _emit_partial(faultfree_fleet=faultfree)
         faulted = run_fleet(
             "faults",
             target_steps=sizes["fleet_steps"],
@@ -919,6 +1060,7 @@ def main() -> None:
             replicas=replicas,
         )
         print(f"bench: fleet with faults {faulted}", file=sys.stderr)
+        _emit_partial(faulted_fleet=faulted)
         faults = {
             "fleet_steps": sizes["fleet_steps"],
             "kill_every": sizes["kill_every"],
@@ -935,6 +1077,7 @@ def main() -> None:
 
         if not os.environ.get("TPUFT_BENCH_SKIP_DILOCO"):
             diloco = _run_diloco_phase(sizes, worker_platform, replicas)
+            _emit_partial(diloco=diloco)
 
     if ratio is None:
         # fleet phases unusable: fall back to the ws=1 protocol ratio so the
@@ -965,6 +1108,20 @@ def main() -> None:
             out["mean_heal_in_s"] = faults["mean_heal_in_s"]
     if diloco:
         out["diloco"] = diloco
+    # repeat the headline keys at the END of the line: the driver captures
+    # the output *tail*, and round 3's artifact lost the head
+    # (metric/value/platform/mfu) to that truncation
+    out["tail"] = {
+        "metric": out["metric"],
+        "value": out["value"],
+        "platform": single.get("platform"),
+        "device_kind": single.get("device_kind"),
+        "cpu_fallback": fallback,
+        "mfu": single.get("mfu"),
+        "model_tflops_per_sec": single.get("model_tflops_per_sec"),
+        "mean_heal_in_s": out.get("mean_heal_in_s"),
+    }
+    _emit_partial(final=out)
     print(json.dumps(out))
 
 

@@ -109,6 +109,40 @@ class TestLauncher:
         rc = supervisor.run()
         assert rc == 3
 
+    def test_standby_retired_when_group_leaves_fleet(self) -> None:
+        """A parked spare must not outlive its group: on clean exit (and on
+        give-up) the supervisor terminates the standby instead of leaking a
+        process that pins TPU/compile resources."""
+        import threading
+
+        script = (
+            "import os, sys, time\n"
+            "if os.environ.get('TPUFT_STANDBY_GATE'):\n"
+            "    time.sleep(600)\n"  # parked spare: wait forever
+            "time.sleep(0.5)\n"
+            "sys.exit(0)\n"
+        )
+        spec = ReplicaSpec(
+            replica_group_id=0,
+            cmd=[sys.executable, "-c", script],
+            standby=True,
+        )
+        supervisor = ReplicaSupervisor(
+            [spec], lighthouse_addr="127.0.0.1:1", restart_delay_s=0.05
+        )
+        runner = threading.Thread(target=supervisor.run, daemon=True)
+        runner.start()
+        # grab the parked spare while the active process is still running
+        deadline = time.time() + 5.0
+        while time.time() < deadline and 0 not in supervisor._standbys:
+            time.sleep(0.02)
+        spare = supervisor._standbys[0][0]
+        assert spare.poll() is None
+        runner.join(timeout=10.0)
+        assert not runner.is_alive()  # clean exit ended supervision
+        assert not supervisor._standbys
+        assert spare.wait(timeout=5.0) is not None  # spare terminated
+
     def test_env_contract(self, tmp_path) -> None:
         out = tmp_path / "env.json"
         script = (

@@ -1,0 +1,126 @@
+"""Heal-attribution math of the bench harness.
+
+The round-3 artifact showed ``promote_s = -5.44``: the promoted standby and
+the fresh spare re-warmed behind it interleave in one replica log, and the
+old phase walk attributed the spare's boot to the heal.  The fix keys every
+event by writer pid and attributes a kill only to the incarnation that
+logged the rejoin step.  The reference measures heal timings in its manager
+integration harness (``torchft/manager_integ_test.py:340-430``).
+"""
+
+import bench
+
+
+def _phases(pid, t0, *names_and_offsets):
+    return [
+        {"phase": name, "ts": t0 + dt, "pid": pid}
+        for name, dt in names_and_offsets
+    ]
+
+
+class TestHealBreakdown:
+    def test_cold_respawn_all_phases_nonnegative_and_sum(self):
+        kill, rejoin = 100.0, 108.0
+        recs = _phases(
+            42,
+            kill,
+            ("proc_start", 1.0),
+            ("jax_ready", 3.0),
+            ("model_ready", 5.0),
+            ("manager_ready", 6.0),
+        )
+        recs.append({"step": 7, "ts": rejoin, "pid": 42})
+        bd = bench._heal_breakdown(recs, kill, rejoin, 42)
+        assert bd["path"] == "cold"
+        assert bd["sane"] is True
+        assert bd["respawn_s"] == 1.0
+        assert bd["jax_init_s"] == 2.0
+        assert bd["model_build_s"] == 2.0
+        assert bd["manager_s"] == 1.0
+        assert bd["join_to_first_commit_s"] == 2.0
+        total = sum(v for v in bd.values() if isinstance(v, float))
+        assert abs(total - (rejoin - kill)) < 0.01
+
+    def test_promoted_standby_ignores_interleaved_spare_boot(self):
+        """The round-3 bug scenario: a spare re-warmed behind the promoted
+        standby logs its boot phases inside the kill->rejoin window."""
+        kill, rejoin = 100.0, 102.0
+        promoted = _phases(
+            10,
+            kill,
+            ("standby_promoted", 0.3),
+            ("manager_ready", 0.5),
+        )
+        promoted.append(
+            {
+                "phase": "first_commit",
+                "ts": kill + 1.9,
+                "pid": 10,
+                "timings": {"quorum_rpc_s": 1.0, "heal_recv_s": 0.3},
+            }
+        )
+        promoted.append({"step": 5, "ts": rejoin, "pid": 10})
+        # the fresh spare boots concurrently — a DIFFERENT incarnation
+        spare = _phases(
+            11,
+            kill,
+            ("proc_start", 0.4),
+            ("jax_ready", 1.2),
+            ("model_ready", 1.8),
+        )
+        bd = bench._heal_breakdown(promoted + spare, kill, rejoin, 10)
+        assert bd["path"] == "standby"
+        assert bd["sane"] is True
+        assert "respawn_s" not in bd  # the spare's boot is off the heal path
+        assert bd["promote_s"] == 0.3
+        assert bd["manager_s"] == 0.2
+        assert bd["join_to_first_commit_s"] == 1.5
+        assert bd["quorum_quorum_rpc_s"] == 1.0
+        assert all(
+            v >= 0 for v in bd.values() if isinstance(v, (int, float))
+        )
+
+    def test_legacy_records_without_pid_still_attribute(self):
+        kill, rejoin = 10.0, 14.0
+        recs = [
+            {"phase": "proc_start", "ts": 11.0},
+            {"phase": "manager_ready", "ts": 12.0},
+            {"step": 3, "ts": rejoin},
+        ]
+        bd = bench._heal_breakdown(recs, kill, rejoin, None)
+        assert bd["respawn_s"] == 1.0
+        assert bd["sane"] is True
+
+
+class TestFleetMetricsAggregation:
+    def test_breakdown_mean_only_over_kills_with_phase(self):
+        """A cold heal and a standby heal in one phase must not drag each
+        other's phase means toward zero."""
+        t = 1000.0
+        kills = [
+            {"ts": t + 10.0, "survivor_step": 5, "victim": 1},
+            {"ts": t + 30.0, "survivor_step": 15, "victim": 1},
+        ]
+        anchor = [
+            {"step": i, "ts": t + i * 2.0, "pid": 1} for i in range(1, 25)
+        ]
+        victim = []
+        # first heal: cold respawn (pid 20), rejoin at t+16
+        victim += _phases(
+            20, t + 10.0, ("proc_start", 2.0), ("manager_ready", 4.0)
+        )
+        victim += [{"step": 6, "ts": t + 16.0, "pid": 20}]
+        # second heal: standby promotion (pid 30), rejoin at t+32
+        victim += _phases(
+            30, t + 30.0, ("standby_promoted", 0.5), ("manager_ready", 0.8)
+        )
+        victim += [{"step": 16, "ts": t + 32.0, "pid": 30}]
+        res = bench._fleet_metrics("x", 20, [anchor, victim], kills)
+        bd = res["heal_breakdown"]
+        assert bd["all_sane"] is True
+        assert bd["paths"] == {"cold": 1, "standby": 1}
+        # respawn_s appears in ONE breakdown; mean must be over that one
+        assert bd["respawn_s"] == 2.0
+        assert bd["promote_s"] == 0.5
+        assert res["heal_in_s"] == [6.0, 2.0]
+        assert len(res["heal_breakdowns"]) == 2

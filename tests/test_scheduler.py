@@ -306,7 +306,11 @@ class TestWatcherRobustness:
         clock["t"] = 5.0
         w.poll_once()  # relaunch; backoff_s == 5
         assert w.groups[0].backoff_s == 5.0
-        # incarnation lives well past healthy_reset_s: backoff forgiven
+        # first RUNNING observation starts the healthy clock...
+        clock["t"] = 10.0
+        w.poll_once()
+        assert w.groups[0].backoff_s == 5.0
+        # ...and an incarnation RUNNING well past healthy_reset_s is forgiven
         clock["t"] = 200.0
         w.poll_once()
         assert w.groups[0].backoff_s == 0.0
@@ -314,3 +318,52 @@ class TestWatcherRobustness:
         backend.states[w.groups[0].job_id] = ["DEAD"]
         w.poll_once()
         assert w.groups[0].backoff_s == 5.0
+
+    def test_pending_time_never_forgives_backoff(self) -> None:
+        """A job stuck PENDING in the queue past healthy_reset_s never ran,
+        so it must not clear its crash-loop backoff."""
+        from torchft_tpu.scheduler import Watcher
+
+        backend = _FakeBackend()
+        clock = {"t": 0.0}
+        w = Watcher(
+            ["a.sbatch"],
+            backend,
+            initial_backoff_s=5.0,
+            healthy_reset_s=100.0,
+            clock=lambda: clock["t"],
+            sleep=lambda s: None,
+        )
+        w.launch_all()
+        backend.states[w.groups[0].job_id] = ["DEAD"]
+        w.poll_once()
+        clock["t"] = 5.0
+        w.poll_once()  # relaunch; backoff_s == 5
+        backend.states[w.groups[0].job_id] = ["PENDING"]
+        clock["t"] = 400.0
+        w.poll_once()
+        assert w.groups[0].backoff_s == 5.0
+
+    def test_run_exits_when_all_groups_give_up(self) -> None:
+        from torchft_tpu.scheduler import Watcher
+
+        backend = _FakeBackend()
+        clock = {"t": 0.0}
+
+        def tick(s):
+            clock["t"] += s
+
+        w = Watcher(
+            ["a.sbatch"],
+            backend,
+            initial_backoff_s=0.0,
+            max_relaunches=1,
+            clock=lambda: clock["t"],
+            sleep=tick,
+        )
+        # every incarnation dies immediately: launch + 1 relaunch, then
+        # give up — run() must return (not hang) with the give-up count
+        backend.states["job1"] = ["DEAD"]
+        backend.states["job2"] = ["DEAD"]
+        assert w.run() == 1
+        assert w.groups[0].gave_up

@@ -177,6 +177,7 @@ class ReplicaSupervisor:
                 if rc == 0:
                     logger.info("replica group %d finished", gid)
                     alive.discard(gid)
+                    self._retire_standby(gid)
                     continue
                 # crash: restart (the whole point of per-step fault tolerance
                 # is that the surviving groups kept training meanwhile)
@@ -194,6 +195,7 @@ class ReplicaSupervisor:
                     # failed group must never read as success
                     worst_rc = max(worst_rc, abs(rc) or 1)
                     alive.discard(gid)
+                    self._retire_standby(gid)
                     continue
                 promoted = False
                 with self._lock:
@@ -232,6 +234,14 @@ class ReplicaSupervisor:
                     # freshly respawned child
                     self._procs[gid] = self._spawn(spec)
         return worst_rc
+
+    def _retire_standby(self, replica_group_id: int) -> None:
+        """A group that left the fleet (clean exit or out of restarts) must
+        not leak its parked spare — the spare holds TPU/compile resources."""
+        with self._lock:
+            sb = self._standbys.pop(replica_group_id, None)
+        if sb is not None and sb[0].poll() is None:
+            sb[0].terminate()
 
     def kill(self, replica_group_id: int, sig: int = signal.SIGKILL) -> bool:
         """Chaos hook: kill one group's process (it will be restarted)."""

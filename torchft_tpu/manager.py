@@ -749,10 +749,12 @@ class Manager:
         # can read a stale participant count and spuriously fail.  A quorum
         # failure becomes a False vote (absorbed by the commit_failures /
         # max_retries path), not an exception out of the train loop —
-        # calling without start_quorum at all is still a loud assert
-        assert self._quorum_future is not None, (
-            "must call start_quorum before should_commit"
-        )
+        # calling without start_quorum at all is still a loud error (a real
+        # raise, not ``assert`` — that would vanish under ``python -O``)
+        if self._quorum_future is None:
+            raise RuntimeError(
+                "must call start_quorum before should_commit"
+            )
         try:
             self.wait_quorum()
         except Exception as e:  # noqa: BLE001 — funnel, never raise

@@ -286,6 +286,7 @@ class _WatchedGroup:
     backoff_s: float = 0.0
     not_before: float = 0.0  # monotonic gate for the next (re)launch
     launched_at: float = 0.0  # when the current incarnation was submitted
+    running_since: float = 0.0  # first observed RUNNING (0 = not yet seen)
     gave_up: bool = False  # out of relaunch budget; no longer polled
 
 
@@ -374,16 +375,25 @@ class Watcher:
             if g.gave_up:
                 continue
             if g.job_id is not None:
-                if self._backend.state(g.job_id) != "DEAD":
-                    # an incarnation that survived a long stretch earns a
-                    # fresh backoff (crash loops keep ratcheting; a job
-                    # dying after days must not wait minutes to respawn)
+                state = self._backend.state(g.job_id)
+                if state != "DEAD":
+                    # an incarnation that survived a long RUNNING stretch
+                    # earns a fresh backoff (crash loops keep ratcheting; a
+                    # job dying after days must not wait minutes to respawn).
+                    # PENDING time doesn't count — a job stuck in the queue
+                    # never ran, so it proved nothing about stability
+                    if state == "RUNNING" and g.running_since == 0.0:
+                        g.running_since = now
+                    elif state != "RUNNING":
+                        g.running_since = 0.0
                     if (
                         g.backoff_s
-                        and now - g.launched_at > self._healthy_reset_s
+                        and g.running_since
+                        and now - g.running_since > self._healthy_reset_s
                     ):
                         g.backoff_s = 0.0
                     continue
+                g.running_since = 0.0
                 # job vanished: schedule a relaunch with backoff
                 if (
                     self._max_relaunches is not None
@@ -422,13 +432,22 @@ class Watcher:
                     )
         return pending
 
-    def run(self) -> None:
-        """Block, monitoring until :meth:`stop` (deployments run this in the
-        foreground the way the reference runner does)."""
+    def run(self) -> int:
+        """Block, monitoring until :meth:`stop` or until every group has
+        permanently given up (deployments run this in the foreground the way
+        the reference runner does).  Returns how many groups gave up — 0 is
+        a clean stop, nonzero means the fleet died for good."""
         self.launch_all()
         while not self._stop:
             self.poll_once()
+            if all(g.gave_up for g in self._groups):
+                logger.error(
+                    "every replica group is out of relaunches; watch loop "
+                    "exiting"
+                )
+                break
             self._sleep(self._poll_s)
+        return sum(1 for g in self._groups if g.gave_up)
 
 
 def main(argv: Optional[List[str]] = None) -> None:
@@ -531,9 +550,12 @@ def main(argv: Optional[List[str]] = None) -> None:
             max_relaunches=args.max_relaunches,
         )
         try:
-            watcher.run()
+            gave_up = watcher.run()
         except KeyboardInterrupt:
             watcher.stop()
+        else:
+            if gave_up:
+                sys.exit(1)
     elif args.submit:
         submit(args.backend, paths)
 
