@@ -489,13 +489,20 @@ def run_fleet(
     for k in ("dim", "layers", "seq", "batch", "head_dim"):
         env[f"TPUFT_BENCH_{k.upper()}"] = str(sizes[f"fleet_{k}"])
     standby = bool(sizes.get("standby")) and kill_every > 0
+    # with >= 3 replicas, leave the LAST victim cold (no spare): victim
+    # rotation then produces both heal paths in one artifact, so the
+    # standby-vs-cold heal-in comparison is measured, not assumed
+    all_standby = os.environ.get("TPUFT_BENCH_ALL_STANDBY", "") not in ("", "0")
+    cold_victim = (
+        replicas - 1 if standby and replicas > 2 and not all_standby else None
+    )
     specs = [
         ReplicaSpec(
             replica_group_id=i,
             cmd=[sys.executable, os.path.abspath(__file__), "--worker"],
             env=dict(env),
             # spares only behind killable replicas (0 is the anchor)
-            standby=standby and i != 0,
+            standby=standby and i != 0 and i != cold_victim,
         )
         for i in range(replicas)
     ]
@@ -762,6 +769,16 @@ def _fleet_metrics(
         agg["all_sane"] = all(bd.get("sane") for bd in breakdowns)
         result["heal_breakdown"] = agg
         result["heal_breakdowns"] = breakdowns
+        # mean heal-in per path: the warm-standby payoff (vs cold respawn)
+        # measured head-to-head in one artifact.  breakdowns[i] and
+        # heal_secs[i] describe the same rejoin (appended together above)
+        if len(breakdowns) == len(heal_secs):
+            by_path: Dict[str, List[float]] = {}
+            for bd, h in zip(breakdowns, heal_secs):
+                by_path.setdefault(bd["path"], []).append(h)
+            result["heal_in_s_by_path"] = {
+                p: round(sum(hs) / len(hs), 1) for p, hs in by_path.items()
+            }
     if overheads:
         result["overhead_per_kill_s"] = round(
             sum(overheads) / len(overheads), 3

@@ -124,3 +124,4 @@ class TestFleetMetricsAggregation:
         assert bd["promote_s"] == 0.5
         assert res["heal_in_s"] == [6.0, 2.0]
         assert len(res["heal_breakdowns"]) == 2
+        assert res["heal_in_s_by_path"] == {"cold": 6.0, "standby": 2.0}

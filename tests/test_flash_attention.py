@@ -179,7 +179,7 @@ def test_sharded_flash_validation() -> None:
 
     mesh = make_mesh(dp=2, tp=2)
     q, k, v = _qkv(3, 256, 4, 2, 64)  # B=3 not divisible by dp=2
-    with pytest.raises(ValueError, match="B%dp"):
+    with pytest.raises(ValueError, match=r"B%\(dp\*fsdp\)"):
         flash_attention_sharded(q, k, v, mesh=mesh, interpret=True)
 
 

@@ -159,8 +159,17 @@ class Llama:
         }
 
     def batch_specs(self) -> Tuple[Any, Any]:
-        """(tokens, targets) PartitionSpecs: batch over dp, sequence over sp."""
-        spec = P("dp", "sp") if self.config.sp_axis else P("dp", None)
+        """(tokens, targets) PartitionSpecs: batch over (dp, fsdp), sequence
+        over sp.  FSDP *is* data parallelism (ZeRO): each fsdp shard must
+        process its own batch slice — batch over dp alone would replicate
+        activations across the fsdp axis and blow HBM at scale (caught by
+        ``parallel/rehearsal.py``: 8B at seq 8192 on a dp=1×fsdp=8 group
+        costs ~66 GB/chip of activations replicated vs ~8 GB sharded)."""
+        spec = (
+            P(("dp", "fsdp"), "sp")
+            if self.config.sp_axis
+            else P(("dp", "fsdp"), None)
+        )
         return spec, spec
 
     # ------------------------------------------------------------------
@@ -192,6 +201,14 @@ class Llama:
             [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
         ).astype(x.dtype)
 
+    @staticmethod
+    def _assumed_backend() -> str:
+        """The platform kernel dispatch plans for.  Normally the runtime
+        backend; ``TORCHFT_FLASH_PLATFORM`` overrides it so a device-free
+        host can trace the TPU program (``parallel/rehearsal.py`` lowers
+        the real Mosaic flash kernels for a pod without owning one)."""
+        return os.environ.get("TORCHFT_FLASH_PLATFORM") or jax.default_backend()
+
     def _use_flash(self, seq: int) -> bool:
         """Dispatch to the fused Pallas kernel (``ops/flash_attention.py``)
         when it applies: TPU backend (or forced), flash-friendly shapes, no
@@ -215,7 +232,7 @@ class Llama:
         # needs a mesh for the shard_map variant (a bare pallas_call is not
         # SPMD-partitionable — inside a tp/fsdp-sharded jit it would force
         # operand replication)
-        if jax.default_backend() != "tpu":
+        if self._assumed_backend() != "tpu":
             return False
         return jax.device_count() == 1 or self._flash_mesh() is not None
 
@@ -247,17 +264,22 @@ class Llama:
                 flash_attention_sharded,
             )
 
-            interpret = jax.default_backend() != "tpu"
+            interpret = self._assumed_backend() != "tpu"
             mesh = self._flash_mesh()
             B, _, H, _ = q.shape
-            if jax.device_count() == 1 or mesh is None:
+            mesh_size = (
+                1 if mesh is None
+                else int(np.prod(list(mesh.shape.values())))
+            )
+            if mesh_size == 1:
                 # bare kernel: single-device programs, or forced via env
                 # without a mesh (then operands replicate — caller's call)
                 return flash_attention(
                     q, k, v, causal=True, interpret=interpret
                 )
+            bp = mesh.shape["dp"] * mesh.shape.get("fsdp", 1)
             if (
-                B % mesh.shape["dp"] == 0
+                B % bp == 0  # batch shards over (dp, fsdp)
                 and H % mesh.shape["tp"] == 0
                 and cfg.n_kv_heads % mesh.shape["tp"] == 0
             ):

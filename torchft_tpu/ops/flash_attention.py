@@ -515,19 +515,21 @@ def flash_attention_sharded(
     block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash attention under an SPMD mesh: batch over ``dp``, heads over
-    ``tp``.
+    """Flash attention under an SPMD mesh: batch over ``(dp, fsdp)``,
+    heads over ``tp``.
 
     A bare ``pallas_call`` is not SPMD-partitionable, so inside a sharded
     jit it would force operand replication; attention is embarrassingly
     parallel over (batch, head), so a shard_map manual over the whole mesh
-    with specs ``P(dp, None, tp, None)`` runs the kernel on local blocks
-    with zero communication.  Activations are replicated over ``fsdp``
-    (exactly like the XLA naive path); ``sp``/``pp``/``ep`` paths have
-    their own attention plumbing and must not route here.
+    with specs ``P((dp, fsdp), None, tp, None)`` runs the kernel on local
+    blocks with zero communication.  The batch dim carries the ``fsdp``
+    axis because activations shard over it (``Llama.batch_specs`` — FSDP
+    is data parallelism); a dp-only spec would make XLA all-gather q/k/v
+    over ``fsdp`` at every layer.  ``sp``/``pp``/``ep`` paths have their
+    own attention plumbing and must not route here.
 
-    Requires B % dp == 0, H % tp == 0, KV % tp == 0 (so each shard keeps
-    the full GQA group ratio).
+    Requires B % (dp*fsdp) == 0, H % tp == 0, KV % tp == 0 (so each shard
+    keeps the full GQA group ratio).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -536,14 +538,17 @@ def flash_attention_sharded(
     B, S, H, D = q.shape
     KV = k.shape[2]
     dp = mesh.shape[dp_axis]
+    fsdp_axis = "fsdp" if "fsdp" in mesh.shape else None
+    bp = dp * (mesh.shape[fsdp_axis] if fsdp_axis else 1)
     tp = mesh.shape[tp_axis]
-    if B % dp or H % tp or KV % tp:
+    if B % bp or H % tp or KV % tp:
         raise ValueError(
-            f"flash_attention_sharded needs B%dp==0, H%tp==0, KV%tp==0; "
-            f"got B={B} H={H} KV={KV} over dp={dp} tp={tp}"
+            f"flash_attention_sharded needs B%(dp*fsdp)==0, H%tp==0, "
+            f"KV%tp==0; got B={B} H={H} KV={KV} over dp*fsdp={bp} tp={tp}"
         )
 
-    spec = P(dp_axis, None, tp_axis, None)
+    batch_entry = (dp_axis, fsdp_axis) if fsdp_axis else dp_axis
+    spec = P(batch_entry, None, tp_axis, None)
     body = functools.partial(
         flash_attention,
         causal=causal,

@@ -195,10 +195,12 @@ def ring_attention_sharded(
     """Ring attention entry point for jit-traced (global-shape) arrays.
 
     q: [B, S, H, D]; k/v: [B, S, KV, D] un-repeated (H % KV == 0), with S
-    sharded over ``sp_axis``, B over ``dp``, and heads over ``tp``;
-    returns attention output in q's layout.
+    sharded over ``sp_axis``, B over ``(dp, fsdp)`` (activations shard
+    over the fsdp axis too — ``Llama.batch_specs``), and heads over
+    ``tp``; returns attention output in q's layout.
     """
-    spec = P("dp", sp_axis, "tp", None)
+    batch_entry = ("dp", "fsdp") if "fsdp" in mesh.shape else "dp"
+    spec = P(batch_entry, sp_axis, "tp", None)
     fn = _shard_map(
         partial(_ring_attention_local, axis_name=sp_axis),
         mesh=mesh,
