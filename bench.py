@@ -185,12 +185,14 @@ def _sizes(on_cpu: bool) -> Dict[str, int]:
         # is promoted on kill, cutting heal-in from cold-start seconds to
         # join+transfer seconds (0 measures the cold path instead)
         "standby": env_int("TPUFT_BENCH_STANDBY", 1, 1),
-        # phase D (DiLoCo): inner steps + streaming-fragment schedule
-        "diloco_steps": env_int("TPUFT_BENCH_DILOCO_STEPS", 24, 80),
+        # phase D (DiLoCo): inner steps + streaming-fragment schedule;
+        # >= 3 in-window kills on TPU so the churn ratio isn't a
+        # sample-of-one
+        "diloco_steps": env_int("TPUFT_BENCH_DILOCO_STEPS", 24, 96),
         "diloco_sync_every": env_int("TPUFT_BENCH_DILOCO_SYNC", 8, 8),
         "diloco_fragments": 2,
         "diloco_sync_delay": 2,
-        "diloco_kills": env_int("TPUFT_BENCH_DILOCO_KILLS", 1, 2),
+        "diloco_kills": env_int("TPUFT_BENCH_DILOCO_KILLS", 1, 3),
     }
 
 
@@ -1108,6 +1110,7 @@ def main() -> None:
         # the per-kill disruption overhead (see _fleet_metrics)
         metric = "ft_withfaults_vs_faultfree_tokens_per_sec_ratio_100step_kill"
 
+    qdr_active, qdr_reason = _quant_device_reduce_active()
     out = {
         "metric": metric,
         "value": round(ratio, 4),
@@ -1116,7 +1119,8 @@ def main() -> None:
         # which quantized-allreduce reduction path this env would run
         # (device Pallas dequant-sum-requant vs host): recorded because the
         # tunnel auto-gates the device path off (benchmarks/RESULTS.md)
-        "quant_device_reduce": _quant_device_reduce_active(),
+        "quant_device_reduce": qdr_active,
+        "quant_device_reduce_reason": qdr_reason,
         **single,
     }
     if faults:
@@ -1142,10 +1146,25 @@ def main() -> None:
     print(json.dumps(out))
 
 
-def _quant_device_reduce_active() -> bool:
-    from torchft_tpu.collectives import _use_device_reduce
+def _quant_device_reduce_active() -> Tuple[bool, str]:
+    """(active, reason) for the Pallas dequant-sum-requant path at a 1 MB
+    shard.  Recorded in the artifact because the axon debug tunnel turns
+    every H2D/D2H into a network round trip, making the device reduce a
+    net loss there even though it wins on locally-attached chips
+    (benchmarks/RESULTS.md)."""
+    import jax
 
-    return bool(_use_device_reduce(1 << 20))
+    from torchft_tpu.collectives import DEVICE_REDUCE_ENV, _use_device_reduce
+
+    active = bool(_use_device_reduce(1 << 20))
+    mode = os.environ.get(DEVICE_REDUCE_ENV, "")
+    if mode == "0":
+        return active, "forced off via env"
+    if mode == "1":
+        return active, "forced on via env"
+    if jax.default_backend() != "tpu":
+        return active, "off: backend is not tpu"
+    return active, "auto (tpu backend, >=256KiB shards)"
 
 
 def _run_diloco_phase(

@@ -29,6 +29,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _make_comm(backend: str, timeout_s: float = 30.0):
+    if backend.startswith("baby-"):
+        # subprocess-isolated tier: payloads cross via shared memory; the
+        # interesting number is its overhead vs the direct tier
+        from torchft_tpu.baby import BabyCommunicator
+
+        return BabyCommunicator(
+            timeout_s=timeout_s, backend=backend.split("-", 1)[1]
+        )
     if backend == "cpp":
         from torchft_tpu.native import CppCommunicator
 
@@ -88,7 +96,11 @@ def worker(rank: int, store_addr: str, backend: str, mb: int, iters: int) -> Non
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--backend", default="cpp", choices=["cpp", "tcp"])
+    p.add_argument(
+        "--backend",
+        default="cpp",
+        choices=["cpp", "tcp", "baby-cpp", "baby-tcp"],
+    )
     p.add_argument("--mb", type=int, default=64)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--rank", type=int, default=-1)

@@ -58,6 +58,158 @@ def test_baby_allreduce_two_ranks(store) -> None:
         np.testing.assert_allclose(res, np.full(257, 3.0))
 
 
+def test_baby_allreduce_shm_path(store) -> None:
+    """Payloads over the threshold cross via shared memory: in_place lands
+    results in the caller's buffers, fresh copies otherwise, and mixed-size
+    multi-buffer ops round-trip exactly."""
+
+    def _one(rank: int):
+        comm = BabyCommunicator(timeout_s=30.0)
+        comm.configure(
+            f"127.0.0.1:{store.port}/shm",
+            replica_id=f"r{rank}",
+            rank=rank,
+            world_size=2,
+        )
+        try:
+            # 1 MB float32 + small bf16-ish second buffer: above _SHM_MIN
+            big = np.full(256 * 1024, float(rank + 1), dtype=np.float32)
+            small = np.full(33, float(10 * (rank + 1)), dtype=np.float32)
+            out = comm.allreduce(
+                [big, small], ReduceOp.SUM, in_place=True
+            ).wait(timeout=30.0)
+            # in_place: the reduced values are IN the caller's arrays
+            assert out[0] is big and out[1] is small
+            rs_in = np.arange(262144, dtype=np.float32)
+            shard = comm.reduce_scatter(rs_in, ReduceOp.SUM).wait(timeout=30.0)
+            comm.barrier().wait(timeout=30.0)
+            return big, small, shard, rank
+        finally:
+            comm.shutdown()
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        results = list(pool.map(_one, range(2)))
+    for big, small, shard, rank in results:
+        np.testing.assert_allclose(big, np.full(256 * 1024, 3.0))
+        np.testing.assert_allclose(small, np.full(33, 30.0))
+        # reduce_scatter of 2x identical arange: this rank's half, doubled
+        half = 262144 // 2
+        expect = 2.0 * np.arange(rank * half, (rank + 1) * half, dtype=np.float32)
+        np.testing.assert_allclose(shard, expect)
+
+
+def test_baby_contract_parity_across_size_threshold(store) -> None:
+    """The Communicator contract must not flip at _SHM_MIN: bare-ndarray
+    input returns a bare ndarray, in_place lands results in the caller's
+    buffer, and broadcast never mutates a non-root caller's input —
+    at BOTH payload sizes."""
+
+    def _one(rank: int):
+        comm = BabyCommunicator(timeout_s=30.0)
+        comm.configure(
+            f"127.0.0.1:{store.port}/parity",
+            replica_id=f"r{rank}",
+            rank=rank,
+            world_size=2,
+        )
+        try:
+            facts = {}
+            for label, n in (("small", 257), ("big", 256 * 1024)):
+                arr = np.full(n, float(rank + 1), dtype=np.float32)
+                out = comm.allreduce(arr, ReduceOp.SUM, in_place=True).wait(
+                    timeout=30.0
+                )
+                facts[f"{label}_bare"] = isinstance(out, np.ndarray)
+                facts[f"{label}_in_place"] = bool(
+                    np.allclose(arr, 3.0)
+                )
+                b = np.full(n, float(rank + 7), dtype=np.float32)
+                bout = comm.broadcast(b, root=0).wait(timeout=30.0)
+                bcast = bout if isinstance(bout, np.ndarray) else bout[0]
+                facts[f"{label}_bcast_value"] = float(np.asarray(bcast)[0])
+                # non-root caller's input untouched
+                facts[f"{label}_input_kept"] = bool(
+                    np.allclose(b, float(rank + 7))
+                )
+            comm.barrier().wait(timeout=30.0)
+            return rank, facts
+        finally:
+            comm.shutdown()
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        results = dict(pool.map(_one, range(2)))
+    for rank, facts in results.items():
+        for label in ("small", "big"):
+            assert facts[f"{label}_bare"], (rank, label, facts)
+            assert facts[f"{label}_in_place"], (rank, label, facts)
+            assert facts[f"{label}_bcast_value"] == 7.0, (rank, label, facts)
+            assert facts[f"{label}_input_kept"], (rank, label, facts)
+
+
+def test_baby_send_bytes_non_contiguous(store) -> None:
+    """Strided ndarrays must ship (the direct tiers accept them)."""
+
+    def _one(rank: int):
+        comm = BabyCommunicator(timeout_s=30.0)
+        comm.configure(
+            f"127.0.0.1:{store.port}/stride",
+            replica_id=f"r{rank}",
+            rank=rank,
+            world_size=2,
+        )
+        try:
+            if rank == 0:
+                strided = np.arange(1000, dtype=np.float32)[::2]
+                comm.send_bytes(strided, dst=1, tag=5).wait(timeout=30.0)
+                return None
+            got = comm.recv_bytes(0, tag=5).wait(timeout=30.0)
+            return np.frombuffer(got, dtype=np.float32)
+        finally:
+            comm.shutdown()
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        results = list(pool.map(_one, range(2)))
+    np.testing.assert_allclose(
+        results[1], np.arange(1000, dtype=np.float32)[::2]
+    )
+
+
+def test_baby_shm_broadcast_and_arena_reuse(store) -> None:
+    def _one(rank: int):
+        comm = BabyCommunicator(timeout_s=30.0)
+        comm.configure(
+            f"127.0.0.1:{store.port}/shmb",
+            replica_id=f"r{rank}",
+            rank=rank,
+            world_size=2,
+        )
+        try:
+            outs = []
+            for i in range(3):  # repeated same-size ops must reuse arenas
+                data = np.full(
+                    128 * 1024, float((rank + 1) * (i + 1)), dtype=np.float32
+                )
+                out = comm.broadcast(data, root=0).wait(timeout=30.0)
+                assert isinstance(out, np.ndarray)  # bare in, bare out
+                outs.append(np.asarray(out).copy())
+            comm.barrier().wait(timeout=30.0)
+            arenas = comm._arenas
+            with arenas._lock:
+                n_live = len(arenas._live)
+            return outs, n_live
+        finally:
+            comm.shutdown()
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        results = list(pool.map(_one, range(2)))
+    for outs, n_live in results:
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(
+                out, np.full(128 * 1024, float(i + 1))  # root=0's values
+            )
+        assert n_live == 1  # one arena recycled across the three ops
+
+
 def test_baby_kill_recovers(store) -> None:
     """Killing the child (a wedge no abort can reach) fails in-flight work
     and a reconfigure respawns a healthy child."""

@@ -1,5 +1,9 @@
 """Device-side quantization kernel tests (jnp fallback on CPU, Pallas
-interpret-mode equivalence, and the full device-quantized gradient path)."""
+interpret-mode equivalence, fp8 device/host wire equivalence + golden
+fixtures, and the full device-quantized gradient path)."""
+
+import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -11,12 +15,20 @@ from torchft_tpu.ddp import ft_allreduce
 from torchft_tpu.manager import Manager
 from torchft_tpu.ops.pallas_quant import (
     BLOCK_ROWS,
+    FP8,
     dequantize_int8_rowwise_device,
+    dequantize_rowwise_device,
     quantize_int8_rowwise_device,
+    quantize_rowwise_device,
+    reduce_quantized_device,
 )
-from torchft_tpu.quantization import quantize_int8_rowwise
+from torchft_tpu.quantization import quantize_int8_rowwise, quantize_rowwise
 
 from tests.test_manager import MemoryTransport, StubClient, _quorum_result
+
+WIRE_FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "quant_wire_golden.json"
+)
 
 
 class TestDeviceQuantKernels:
@@ -63,6 +75,157 @@ class TestDeviceQuantKernels:
         q, s = quantize_int8_rowwise_device(jnp.zeros(100), row_size=128)
         out = dequantize_int8_rowwise_device(q, s, n=100)
         np.testing.assert_array_equal(np.asarray(out), np.zeros(100))
+
+
+class TestDeviceFp8Kernels:
+    """fp8 (e4m3) device kernels: parity with the host wire format
+    (reference ships fp8 quantized collectives,
+    ``torchft/quantization.py:30-41``)."""
+
+    def test_device_matches_host_wire_bytes(self) -> None:
+        rng = np.random.default_rng(2)
+        flat = rng.normal(size=4096).astype(np.float32) * 10.0
+        q_dev, s_dev = quantize_rowwise_device(
+            jnp.asarray(flat), row_size=1024, kind=FP8
+        )
+        q_host, s_host = quantize_rowwise(flat, row_size=1024, kind=FP8)
+        rows = q_host.shape[0]
+        # bit-identical payload (both sides clip then round-to-nearest-even)
+        np.testing.assert_array_equal(
+            np.asarray(q_dev)[:rows].view(np.uint8), q_host.view(np.uint8)
+        )
+        np.testing.assert_allclose(
+            np.asarray(s_dev).reshape(-1)[:rows], s_host, rtol=1e-6
+        )
+
+    def test_roundtrip_error_bound(self) -> None:
+        rng = np.random.default_rng(3)
+        flat = rng.normal(size=3000).astype(np.float32)
+        q, s = quantize_rowwise_device(jnp.asarray(flat), kind=FP8)
+        out = dequantize_rowwise_device(q, s, n=3000)
+        # e4m3: 3 mantissa bits → ~6% relative near the top of the range
+        err = np.abs(np.asarray(out) - flat)
+        assert err.max() <= np.abs(flat).max() * 0.07
+
+    def test_pallas_interpret_equivalence_fp8(self) -> None:
+        rng = np.random.default_rng(4)
+        flat = jnp.asarray(
+            rng.normal(size=BLOCK_ROWS * 256).astype(np.float32)
+        )
+        q_ref, s_ref = quantize_rowwise_device(flat, row_size=256, kind=FP8)
+        q_pl, s_pl = quantize_rowwise_device(
+            flat, row_size=256, kind=FP8, interpret=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(q_pl).view(np.uint8), np.asarray(q_ref).view(np.uint8)
+        )
+        np.testing.assert_allclose(
+            np.asarray(s_pl), np.asarray(s_ref), rtol=1e-6
+        )
+
+    def test_reduce_matches_host_reduce(self) -> None:
+        from torchft_tpu.quantization import reduce_quantized
+
+        rng = np.random.default_rng(5)
+        w = 3
+        contributions = [
+            rng.normal(size=BLOCK_ROWS * 128).astype(np.float32)
+            for _ in range(w)
+        ]
+        qs, scs = zip(
+            *(quantize_rowwise(c, row_size=128, kind=FP8) for c in contributions)
+        )
+        q_host, s_host = reduce_quantized(
+            np.stack(qs), np.stack(scs), kind=FP8
+        )
+        q_dev, s_dev = reduce_quantized_device(
+            jnp.asarray(np.stack(qs)),
+            jnp.asarray(np.stack(scs))[:, :, None],
+            kind=FP8,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(q_dev).view(np.uint8), q_host.view(np.uint8)
+        )
+        np.testing.assert_allclose(
+            np.asarray(s_dev).reshape(-1), s_host, rtol=1e-6
+        )
+
+    def test_reduce_interpret_equivalence_fp8(self) -> None:
+        rng = np.random.default_rng(6)
+        qs = []
+        scs = []
+        for _ in range(2):
+            q, s = quantize_rowwise(
+                rng.normal(size=BLOCK_ROWS * 128).astype(np.float32),
+                row_size=128,
+                kind=FP8,
+            )
+            qs.append(q)
+            scs.append(s)
+        args = (
+            jnp.asarray(np.stack(qs)),
+            jnp.asarray(np.stack(scs))[:, :, None],
+        )
+        q_ref, s_ref = reduce_quantized_device(*args, kind=FP8)
+        q_pl, s_pl = reduce_quantized_device(*args, kind=FP8, interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(q_pl).view(np.uint8), np.asarray(q_ref).view(np.uint8)
+        )
+        np.testing.assert_allclose(
+            np.asarray(s_pl), np.asarray(s_ref), rtol=1e-6
+        )
+
+
+class TestWireGolden:
+    """Golden-fixture lock on BOTH wire formats: a deterministic input must
+    quantize to byte-identical payloads across rounds (regenerate with
+    WRITE_FIXTURE=true) — the analog of the reference's quantization unit
+    goldens."""
+
+    def _wire(self):
+        rng = np.random.default_rng(42)
+        flat = (rng.normal(size=512) * np.logspace(-2, 2, 512)).astype(
+            np.float32
+        )
+        out = {}
+        for kind in ("int8", "fp8"):
+            q, s = quantize_rowwise(flat, row_size=128, kind=kind)
+            out[kind] = {
+                "payload": q.view(np.uint8).reshape(-1).tolist(),
+                "scales": s.astype(float).tolist(),
+            }
+        return out
+
+    def test_wire_matches_fixture(self) -> None:
+        wire = self._wire()
+        if os.environ.get("WRITE_FIXTURE") == "true":
+            with open(WIRE_FIXTURE, "w") as f:
+                json.dump(wire, f)
+            pytest.skip("fixture regenerated")
+        with open(WIRE_FIXTURE) as f:
+            expected = json.load(f)
+        for kind in ("int8", "fp8"):
+            assert wire[kind]["payload"] == expected[kind]["payload"], kind
+            np.testing.assert_allclose(
+                wire[kind]["scales"], expected[kind]["scales"], rtol=1e-6
+            )
+
+    def test_device_quantizer_matches_fixture(self) -> None:
+        if not os.path.exists(WIRE_FIXTURE):
+            pytest.skip("fixture not generated yet")
+        rng = np.random.default_rng(42)
+        flat = (rng.normal(size=512) * np.logspace(-2, 2, 512)).astype(
+            np.float32
+        )
+        with open(WIRE_FIXTURE) as f:
+            expected = json.load(f)
+        for kind in ("int8", "fp8"):
+            q, _s = quantize_rowwise_device(
+                jnp.asarray(flat), row_size=128, kind=kind
+            )
+            rows = len(expected[kind]["scales"])
+            got = np.asarray(q)[:rows].view(np.uint8).reshape(-1).tolist()
+            assert got == expected[kind]["payload"], kind
 
 
 class TestDeviceQuantizedGradientPath:

@@ -9,18 +9,24 @@ results return over a future pipe serviced by a listener thread
 (``process_group.py:1697-1730``).
 
 Differences from the reference: no CUDA stream replication is needed (our
-data plane is host numpy), and buffers ship by pickle rather than shared
-memory — correctness first; a shared-memory ring is a straightforward later
-optimization for multi-GB gradients.
+data plane is host numpy).  Array payloads at or above
+``TORCHFT_BABY_SHM_MIN`` bytes (default 256 KiB) cross the process
+boundary through **shared memory** — the pipe carries only a segment name
+plus dtype/shape metadata, mirroring the reference's move-to-shm before
+the pickle hop (``torchft/process_group.py:1425-1436``) — so the
+isolation tier works at multi-GB gradient scale.  Small payloads and
+byte-blob ops still pickle (the copy is cheaper than an arena round-trip).
 """
 
 from __future__ import annotations
 
 import logging
 import multiprocessing as mp
+import os
 import threading
 from concurrent.futures import Future
-from typing import Dict, Optional, Sequence
+from multiprocessing import shared_memory
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -35,6 +41,73 @@ from torchft_tpu.multiprocessing import MonitoredPipe
 from torchft_tpu.work import Work
 
 logger = logging.getLogger(__name__)
+
+# arrays at/above this ship via shared memory instead of pickle
+_SHM_MIN = int(os.environ.get("TORCHFT_BABY_SHM_MIN", str(256 << 10)))
+_ALIGN = 64
+
+
+def _aligned(n: int) -> int:
+    return -(-n // _ALIGN) * _ALIGN
+
+
+# (dtype str, shape, byte offset into the arena)
+_Meta = Tuple[str, Tuple[int, ...], int]
+
+
+def _pack_metas(arrays: List[np.ndarray]) -> Tuple[List[_Meta], int]:
+    metas: List[_Meta] = []
+    off = 0
+    for a in arrays:
+        metas.append((a.dtype.str, tuple(a.shape), off))
+        off += _aligned(a.nbytes)
+    return metas, off
+
+
+def _views(buf: memoryview, metas: List[_Meta]) -> List[np.ndarray]:
+    out = []
+    for dtype, shape, off in metas:
+        dt = np.dtype(dtype)
+        n = int(np.prod(shape, dtype=np.int64))
+        out.append(
+            np.frombuffer(buf, dtype=dt, count=n, offset=off).reshape(shape)
+        )
+    return out
+
+
+class _ShmAttachCache:
+    """Child-side attachment cache: arenas are reused across ops, so attach
+    once per name.  Attachments are unregistered from the resource tracker
+    — the parent owns the segment lifecycle, and the spawned child's
+    tracker would otherwise unlink live segments at exit (cpython #82300).
+    """
+
+    def __init__(self) -> None:
+        self._cache: Dict[str, shared_memory.SharedMemory] = {}
+
+    def get(self, name: str) -> shared_memory.SharedMemory:
+        shm = self._cache.get(name)
+        if shm is None:
+            shm = shared_memory.SharedMemory(name=name)
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+            except Exception:  # noqa: BLE001 — tracker internals shifted
+                pass
+            self._cache[name] = shm
+        return shm
+
+    def close(self) -> None:
+        for shm in self._cache.values():
+            try:
+                shm.close()
+            except (OSError, BufferError):
+                # BufferError: numpy views of shm.buf created in the worker
+                # loop may still be alive at shutdown; the mapping dies with
+                # the process either way
+                pass
+        self._cache.clear()
 
 
 def _worker_main(cmd_pipe, out_pipe, backend: str, timeout_s: float) -> None:
@@ -52,6 +125,7 @@ def _worker_main(cmd_pipe, out_pipe, backend: str, timeout_s: float) -> None:
         out_pipe.send((-1, RuntimeError(f"baby worker init failed: {e}")))
         return
 
+    shms = _ShmAttachCache()
     while True:
         try:
             msg = cmd_pipe.recv()
@@ -64,6 +138,42 @@ def _worker_main(cmd_pipe, out_pipe, backend: str, timeout_s: float) -> None:
             if op == "configure":
                 comm.configure(**args)
                 result = None
+            elif op in ("allreduce_shm", "broadcast_shm"):
+                # payload lives in the parent's arena: operate on views
+                # in-place so results land back in the same segment and the
+                # reply is metadata only
+                shm = shms.get(args["shm"])
+                views = _views(shm.buf, args["metas"])
+                if op == "allreduce_shm":
+                    got = comm.allreduce(
+                        views, args["op"], in_place=True
+                    ).wait(timeout=timeout_s)
+                else:
+                    got = comm.broadcast(views, args["root"]).wait(
+                        timeout=timeout_s
+                    )
+                if isinstance(got, np.ndarray):
+                    got = [got]
+                for view, res in zip(views, got):
+                    if res is not view:
+                        np.copyto(view, res.reshape(view.shape))
+                result = {"shm": args["shm"]}
+            elif op == "reduce_scatter_shm":
+                shm = shms.get(args["shm"])
+                (view,) = _views(shm.buf, args["metas"])
+                shard = comm.reduce_scatter(view, args["op"]).wait(
+                    timeout=timeout_s
+                )
+                shard = np.asarray(shard)
+                # the shard is smaller than the input: write it at offset 0
+                flat = np.frombuffer(
+                    shm.buf, dtype=shard.dtype, count=shard.size
+                )
+                np.copyto(flat, shard.reshape(-1))
+                result = {
+                    "shm": args["shm"],
+                    "meta": (shard.dtype.str, tuple(shard.shape), 0),
+                }
             elif op == "allreduce":
                 result = comm.allreduce(args["buffers"], args["op"]).wait(
                     timeout=timeout_s
@@ -76,10 +186,23 @@ def _worker_main(cmd_pipe, out_pipe, backend: str, timeout_s: float) -> None:
                 result = comm.send_bytes(args["data"], args["dst"], args["tag"]).wait(
                     timeout=timeout_s
                 )
+            elif op == "send_bytes_shm":
+                shm = shms.get(args["shm"])
+                view = np.frombuffer(shm.buf, np.uint8, count=args["n"])
+                result = comm.send_bytes(view, args["dst"], args["tag"]).wait(
+                    timeout=timeout_s
+                )
             elif op == "recv_bytes":
                 result = comm.recv_bytes(args["src"], args["tag"]).wait(
                     timeout=timeout_s
                 )
+            elif op == "recv_bytes_shm":
+                shm = shms.get(args["shm"])
+                view = np.frombuffer(shm.buf, np.uint8, count=args["cap"])
+                n = comm.recv_bytes_into(args["src"], view, args["tag"]).wait(
+                    timeout=timeout_s
+                )
+                result = {"shm": args["shm"], "n": n}
             elif op == "reduce_scatter":
                 result = comm.reduce_scatter(args["data"], args["op"]).wait(
                     timeout=timeout_s
@@ -94,7 +217,50 @@ def _worker_main(cmd_pipe, out_pipe, backend: str, timeout_s: float) -> None:
                 out_pipe.send((op_id, RuntimeError(str(e))))
             except (OSError, ValueError):
                 break
+    shms.close()
     comm.shutdown()
+
+
+class _ArenaPool:
+    """Parent-side shared-memory arenas, reused across ops.
+
+    Sizes round up to powers of two so a steady training loop (same bucket
+    sizes every step) allocates once and recycles; the parent owns unlink.
+    """
+
+    def __init__(self) -> None:
+        self._free: Dict[int, List[shared_memory.SharedMemory]] = {}
+        self._lock = threading.Lock()
+        self._live: Dict[str, shared_memory.SharedMemory] = {}
+
+    def acquire(self, nbytes: int) -> shared_memory.SharedMemory:
+        size = 1 << max(12, (nbytes - 1).bit_length())
+        with self._lock:
+            bucket = self._free.get(size)
+            if bucket:
+                return bucket.pop()
+        shm = shared_memory.SharedMemory(create=True, size=size)
+        with self._lock:
+            self._live[shm.name] = shm
+        return shm
+
+    def release(self, shm: shared_memory.SharedMemory) -> None:
+        with self._lock:
+            if shm.name not in self._live:
+                return  # destroyed concurrently (abort path)
+            self._free.setdefault(shm.size, []).append(shm)
+
+    def destroy(self) -> None:
+        with self._lock:
+            live = list(self._live.values())
+            self._live.clear()
+            self._free.clear()
+        for shm in live:
+            try:
+                shm.close()
+                shm.unlink()
+            except OSError:  # pragma: no cover - already gone
+                pass
 
 
 class BabyCommunicator(Communicator):
@@ -117,6 +283,7 @@ class BabyCommunicator(Communicator):
         self._rank = 0
         self._world_size = 1
         self._errored: Optional[Exception] = None
+        self._arenas = _ArenaPool()
 
     # -- child lifecycle ----------------------------------------------------
 
@@ -232,35 +399,158 @@ class BabyCommunicator(Communicator):
         if err is not None:
             raise CommunicatorError(f"baby configure failed: {err}") from err
 
+    @staticmethod
+    def _as_list(buffers: Buffers) -> Tuple[List[np.ndarray], bool]:
+        """(array list, was-a-single-ndarray) — the Communicator contract
+        returns a bare ndarray for bare-ndarray input."""
+        if isinstance(buffers, np.ndarray):
+            return [buffers], True
+        return [np.asarray(b) for b in buffers], False
+
+    def _shm_arrays_op(
+        self,
+        op: str,
+        arrays: List[np.ndarray],
+        extra: dict,
+        in_place: bool,
+        single: bool,
+    ) -> Work:
+        """Ship array payloads through a shared-memory arena: the pipe
+        carries only (segment name, metas); the child reduces in-place in
+        the segment; results land back into the caller's buffers (in_place)
+        or fresh copies."""
+        metas, total = _pack_metas(arrays)
+        shm = self._arenas.acquire(total)
+        for a, view in zip(arrays, _views(shm.buf, metas)):
+            np.copyto(view, a)
+        work = self._submit(op, dict(shm=shm.name, metas=metas, **extra))
+
+        def _land(result: object):
+            if isinstance(result, dict) and "meta" in result:
+                # reduce_scatter: the child re-described the (smaller) shard
+                (out,) = _views(shm.buf, [result["meta"]])
+                return out.copy()
+            views = _views(shm.buf, metas)
+            if in_place:
+                for a, v in zip(arrays, views):
+                    np.copyto(a, v)
+                out_list = arrays
+            else:
+                out_list = [v.copy() for v in views]
+            return out_list[0] if single else out_list
+
+        landed = work.then(_land)
+        # release on ANY outcome — a failed op must not leak the arena
+        landed.future().add_done_callback(
+            lambda _f: self._arenas.release(shm)
+        )
+        return landed
+
     def allreduce(
         self,
         buffers: Buffers,
         op: ReduceOp = ReduceOp.SUM,
         in_place: bool = False,
     ) -> Work:
-        # in_place is accepted for interface parity but meaningless across
-        # the subprocess pipe (payloads are pickled both ways)
-        return self._submit("allreduce", dict(buffers=buffers, op=op))
+        arrays, single = self._as_list(buffers)
+        if sum(a.nbytes for a in arrays) >= _SHM_MIN:
+            return self._shm_arrays_op(
+                "allreduce_shm", arrays, dict(op=op), in_place, single
+            )
+        # small payloads: the pickle copy is cheaper than an arena trip.
+        # in_place must mean the same thing at every size: land the
+        # pickled results back in the caller's buffers
+        work = self._submit("allreduce", dict(buffers=buffers, op=op))
+        if not in_place:
+            return work
+
+        def _land_in_place(result):
+            out = [result] if isinstance(result, np.ndarray) else result
+            for a, r in zip(arrays, out):
+                np.copyto(a, np.asarray(r).reshape(a.shape))
+            return arrays[0] if single else arrays
+
+        return work.then(_land_in_place)
 
     def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
+        arrays, single = self._as_list(buffers)
+        if sum(a.nbytes for a in arrays) >= _SHM_MIN:
+            # fresh copies, like the direct tiers (a non-root caller's
+            # input must not be silently overwritten)
+            return self._shm_arrays_op(
+                "broadcast_shm",
+                arrays,
+                dict(root=root),
+                in_place=False,
+                single=single,
+            )
         return self._submit("broadcast", dict(buffers=buffers, root=root))
 
     def reduce_scatter(self, data: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> Work:
+        arr = np.asarray(data)
+        if arr.nbytes >= _SHM_MIN:
+            return self._shm_arrays_op(
+                "reduce_scatter_shm",
+                [arr],
+                dict(op=op),
+                in_place=False,
+                single=True,
+            )
         return self._submit("reduce_scatter", dict(data=data, op=op))
 
     def send_bytes(self, data, dst: int, tag: int = 0) -> Work:
-        # the pipe pickles payloads (copies are inherent to the isolation
-        # tier); memoryviews/arrays must become bytes to cross it
-        if not isinstance(data, bytes):
-            data = bytes(data)
-        return self._submit("send_bytes", dict(data=data, dst=dst, tag=tag))
+        if isinstance(data, bytes):
+            view = data
+        elif isinstance(data, np.ndarray):
+            view = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+        else:
+            try:
+                view = memoryview(data).cast("B")
+            except (ValueError, TypeError):
+                view = bytes(data)  # non-contiguous buffer-likes
+        n = len(view)
+        if n >= _SHM_MIN:
+            shm = self._arenas.acquire(n)
+            np.frombuffer(shm.buf, np.uint8, count=n)[:] = np.frombuffer(
+                view, dtype=np.uint8
+            )
+            work = self._submit(
+                "send_bytes_shm", dict(shm=shm.name, n=n, dst=dst, tag=tag)
+            )
+            work.future().add_done_callback(
+                lambda _f: self._arenas.release(shm)
+            )
+            return work
+        if not isinstance(view, bytes):
+            view = bytes(view)
+        return self._submit("send_bytes", dict(data=view, dst=dst, tag=tag))
 
     def recv_bytes(self, src: int, tag: int = 0) -> Work:
         return self._submit("recv_bytes", dict(src=src, tag=tag))
 
     def recv_bytes_into(self, src: int, out, tag: int = 0) -> Work:
-        # API uniformity: the pipe hop precludes true zero-copy; copy into
-        # the caller's buffer on completion
+        if out.nbytes >= _SHM_MIN:
+            # the child receives straight into the shared segment; the
+            # parent pays one copy into the caller's buffer (the pickle
+            # path pays serialize + deserialize + copy)
+            shm = self._arenas.acquire(out.nbytes)
+            work = self._submit(
+                "recv_bytes_shm",
+                dict(shm=shm.name, cap=out.nbytes, src=src, tag=tag),
+            )
+
+            def _land_shm(result: dict) -> int:
+                n = result["n"]
+                out.reshape(-1).view(np.uint8)[:n] = np.frombuffer(
+                    shm.buf, np.uint8, count=n
+                )
+                return n
+
+            landed = work.then(_land_shm)
+            landed.future().add_done_callback(
+                lambda _f: self._arenas.release(shm)
+            )
+            return landed
         work = self._submit("recv_bytes", dict(src=src, tag=tag))
 
         def _land(blob: object) -> int:
@@ -269,10 +559,8 @@ class BabyCommunicator(Communicator):
                 raise CommunicatorError(
                     f"recv buffer too small: payload {len(data)} > cap {out.nbytes}"
                 )
-            import numpy as _np
-
-            out.reshape(-1).view(_np.uint8)[: len(data)] = _np.frombuffer(
-                data, dtype=_np.uint8
+            out.reshape(-1).view(np.uint8)[: len(data)] = np.frombuffer(
+                data, dtype=np.uint8
             )
             return len(data)
 
@@ -315,3 +603,4 @@ class BabyCommunicator(Communicator):
 
     def shutdown(self) -> None:
         self.abort("shutdown")
+        self._arenas.destroy()

@@ -110,9 +110,11 @@ def _unpack(
 def _reduce_shards(
     qs: np.ndarray, scs: np.ndarray, kind: str
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Dequant-sum-requant ``w`` shards; on a TPU the int8 path runs as the
-    fused Pallas kernel so only 1-byte payloads cross HBM."""
-    if kind == INT8 and _use_device_reduce(qs[0].nbytes):
+    """Dequant-sum-requant ``w`` shards; on a TPU both wire kinds run as
+    the fused Pallas kernel so only 1-byte payloads cross HBM (fp8 falls
+    back to XLA-compiled jnp on chips whose Mosaic can't lower the dtype —
+    see ``pallas_quant._pallas_kind_ok``)."""
+    if _use_device_reduce(qs[0].nbytes):
         import jax
 
         from torchft_tpu.ops.pallas_quant import BLOCK_ROWS, reduce_quantized_device
@@ -121,11 +123,11 @@ def _reduce_shards(
         pad = (-rows) % BLOCK_ROWS
         if pad:
             qs = np.concatenate(
-                [qs, np.zeros((w, pad, row_size), np.int8)], axis=1
+                [qs, np.zeros((w, pad, row_size), qs.dtype)], axis=1
             )
             scs = np.concatenate([scs, np.zeros((w, pad), np.float32)], axis=1)
         q_dev, s_dev = reduce_quantized_device(
-            jax.numpy.asarray(qs), jax.numpy.asarray(scs)[:, :, None]
+            jax.numpy.asarray(qs), jax.numpy.asarray(scs)[:, :, None], kind=kind
         )
         q_host = np.asarray(q_dev)[:rows]
         s_host = np.asarray(s_dev).reshape(-1)[:rows]

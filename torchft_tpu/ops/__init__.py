@@ -9,6 +9,18 @@ _LAZY = {
         "torchft_tpu.ops.pallas_quant",
         "dequantize_int8_rowwise_device",
     ),
+    "quantize_rowwise_device": (
+        "torchft_tpu.ops.pallas_quant",
+        "quantize_rowwise_device",
+    ),
+    "dequantize_rowwise_device": (
+        "torchft_tpu.ops.pallas_quant",
+        "dequantize_rowwise_device",
+    ),
+    "reduce_quantized_device": (
+        "torchft_tpu.ops.pallas_quant",
+        "reduce_quantized_device",
+    ),
 }
 
 __all__ = list(_LAZY)

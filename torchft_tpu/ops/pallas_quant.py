@@ -1,32 +1,53 @@
-"""Pallas TPU kernels: fused rowwise int8 quantize/dequantize.
+"""Pallas TPU kernels: fused rowwise int8 / fp8 quantize/dequantize.
 
 The reference fuses fp8 quantization into triton kernels so quantized
 collectives never materialize intermediate float copies
-(``torchft/quantization.py:44-686``, CUDA).  The TPU equivalent lives here:
-gradients are quantized ON DEVICE before leaving HBM, so the host (and then
-DCN) moves int8 payload + f32 rowwise scales — ~4x fewer bytes off-chip,
-which is the dominant cost of the replica-dimension sync.
+(``torchft/quantization.py:44-686``, CUDA; fp8e4nv on SM90+, int8 fallback
+``quantization.py:30-41``).  The TPU equivalent lives here: gradients are
+quantized ON DEVICE before leaving HBM, so the host (and then DCN) moves
+1-byte payload + f32 rowwise scales — ~4x fewer bytes off-chip, which is
+the dominant cost of the replica-dimension sync.
+
+Two wire kinds, matching the host format (``torchft_tpu/quantization.py``):
+
+- ``int8``: scale = absmax/127, uniform grid;
+- ``fp8``: float8_e4m3fn, scale = absmax/448 — more dynamic range within a
+  row at the cost of non-uniform spacing (the reference's format).
 
 Layout: flat float input viewed as rows of ``row_size`` (last row padded);
-per-row scale = absmax/127.  ``row_size`` is a multiple of 128 (lane width)
-and rows are processed in blocks of 32 sublanes to satisfy int8 tiling
-((32, 128) min tile).
+``row_size`` is a multiple of 128 (lane width) and rows are processed in
+blocks of 32 sublanes to satisfy 1-byte tiling ((32, 128) min tile).
 
 Off-TPU the same math runs as plain jnp (still jittable) — Pallas on CPU is
 interpreter-only, so tests exercise the jnp path plus ``interpret=True``
-equivalence on tiny shapes.
+equivalence on tiny shapes.  On TPU, fp8 Mosaic support depends on the
+chip generation; a one-shot compile probe (:func:`_pallas_kind_ok`) falls
+back to the jnp path (still fused device code, XLA-compiled) when the
+kernel can't lower.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 
 ROW_SIZE = 1024  # multiple of the 128-lane width
-BLOCK_ROWS = 32  # int8 min tile sublane count
+BLOCK_ROWS = 32  # 1-byte min tile sublane count
+
+INT8 = "int8"
+FP8 = "fp8"
+FP8_MAX = 448.0  # float8_e4m3fn max magnitude
+
+
+def _wire_jnp_dtype(kind: str):
+    if kind == INT8:
+        return jnp.int8
+    if kind == FP8:
+        return jnp.float8_e4m3fn
+    raise ValueError(f"unknown wire kind {kind!r}")
 
 
 def _pad_to_rows(flat: jax.Array, row_size: int) -> Tuple[jax.Array, int]:
@@ -39,17 +60,24 @@ def _pad_to_rows(flat: jax.Array, row_size: int) -> Tuple[jax.Array, int]:
     return padded.reshape(rows, row_size), rows
 
 
-def _quant_math(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def _quant_math(x: jax.Array, kind: str = INT8) -> Tuple[jax.Array, jax.Array]:
     absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
-    scale = absmax / 127.0
-    safe = jnp.where(scale > 0, scale, 1.0)
-    q = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
+    if kind == INT8:
+        scale = absmax / 127.0
+        safe = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
+    else:
+        scale = absmax / FP8_MAX
+        safe = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(x / safe, -FP8_MAX, FP8_MAX).astype(
+            _wire_jnp_dtype(kind)
+        )
     return q, scale
 
 
-def _quant_kernel(x_ref, q_ref, s_ref):
+def _quant_kernel(x_ref, q_ref, s_ref, *, kind: str):
     x = x_ref[:].astype(jnp.float32)
-    q, scale = _quant_math(x)
+    q, scale = _quant_math(x, kind)
     q_ref[:] = q
     s_ref[:] = scale
 
@@ -62,25 +90,53 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
-@functools.partial(jax.jit, static_argnames=("row_size", "interpret"))
-def quantize_int8_rowwise_device(
-    flat: jax.Array, row_size: int = ROW_SIZE, interpret: bool = False
+_KIND_OK: Dict[str, bool] = {}
+
+
+def _pallas_kind_ok(kind: str) -> bool:
+    """One-shot probe: can this chip's Mosaic lower the wire dtype?  int8 is
+    universal; fp8 conversion support varies by TPU generation.  Probes
+    BOTH kernels gated on it — the quantize store and the structurally
+    different reduce ([w, rows, R] fp8 loads + multiply) — because either
+    can fail independently."""
+    if kind in _KIND_OK:
+        return _KIND_OK[kind]
+    if kind == INT8:
+        _KIND_OK[kind] = True
+        return True
+    try:
+        x = jnp.ones((BLOCK_ROWS * ROW_SIZE,), jnp.float32)
+        jax.jit(
+            functools.partial(
+                _pallas_quantize, row_size=ROW_SIZE, kind=kind, interpret=False
+            )
+        ).lower(x).compile()
+        qs = jnp.zeros((2, BLOCK_ROWS, ROW_SIZE), _wire_jnp_dtype(kind))
+        sc = jnp.ones((2, BLOCK_ROWS, 1), jnp.float32)
+        _KIND_OK[kind] = True  # allow reduce_quantized_device to take the
+        # pallas branch while we compile-probe it
+        try:
+            jax.jit(
+                functools.partial(reduce_quantized_device, kind=kind)
+            ).lower(qs, sc).compile()
+        except Exception:
+            _KIND_OK[kind] = False
+            raise
+    except Exception:  # noqa: BLE001 — any lowering failure → jnp fallback
+        _KIND_OK[kind] = False
+    return _KIND_OK[kind]
+
+
+def _pallas_quantize(
+    x2d_flat: jax.Array, row_size: int, kind: str, interpret: bool
 ) -> Tuple[jax.Array, jax.Array]:
-    """flat float [n] → (int8 [rows, row_size], f32 scales [rows, 1]).
-
-    Jittable; on TPU runs as a fused Pallas kernel (one HBM read, int8 +
-    scales write), elsewhere as plain jnp.
-    """
-    x, rows = _pad_to_rows(flat, row_size)
-    if not (interpret or _on_tpu()):
-        return _quant_math(x)
-
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    x, rows = _pad_to_rows(x2d_flat, row_size)
     grid = (rows // BLOCK_ROWS,)
     return pl.pallas_call(
-        _quant_kernel,
+        functools.partial(_quant_kernel, kind=kind),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -94,43 +150,66 @@ def quantize_int8_rowwise_device(
             pl.BlockSpec((BLOCK_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, row_size), jnp.int8),
+            jax.ShapeDtypeStruct((rows, row_size), _wire_jnp_dtype(kind)),
             jax.ShapeDtypeStruct((rows, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x)
 
 
-def _reduce_kernel(qs_ref, s_ref, q_ref, out_s_ref):
+@functools.partial(jax.jit, static_argnames=("row_size", "kind", "interpret"))
+def quantize_rowwise_device(
+    flat: jax.Array,
+    row_size: int = ROW_SIZE,
+    kind: str = INT8,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """flat float [n] → (wire payload [rows, row_size], f32 scales
+    [rows, 1]).
+
+    Jittable; on TPU runs as a fused Pallas kernel (one HBM read, 1-byte +
+    scales write), elsewhere — or when the chip can't lower the wire dtype
+    — as plain jnp.
+    """
+    if not (interpret or (_on_tpu() and _pallas_kind_ok(kind))):
+        x, _rows = _pad_to_rows(flat, row_size)
+        return _quant_math(x, kind)
+    return _pallas_quantize(flat, row_size, kind, interpret)
+
+
+def _reduce_kernel(qs_ref, s_ref, q_ref, out_s_ref, *, kind: str):
     # dequant-sum-requant in one VMEM-resident pass (the reference's
-    # fused_reduce_fp8, torchft/quantization.py:638): qs [w, B, R] int8,
+    # fused_reduce_fp8, torchft/quantization.py:638): qs [w, B, R] wire,
     # scales [w, B, 1] f32 -> requantized (q [B, R], scales [B, 1])
     total = jnp.sum(
         qs_ref[:].astype(jnp.float32) * s_ref[:], axis=0
     )
-    q, scale = _quant_math(total)
+    q, scale = _quant_math(total, kind)
     q_ref[:] = q
     out_s_ref[:] = scale
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("kind", "interpret"))
 def reduce_quantized_device(
-    qs: jax.Array, scales: jax.Array, interpret: bool = False
+    qs: jax.Array,
+    scales: jax.Array,
+    kind: str = INT8,
+    interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused dequant-sum-requant of ``w`` quantized contributions ON DEVICE:
-    qs int8 [w, rows, row_size], scales f32 [w, rows, 1] → (int8 [rows,
+    qs wire [w, rows, row_size], scales f32 [w, rows, 1] → (wire [rows,
     row_size], f32 [rows, 1]) of the float32 sum.
 
-    The host ships w int8 shards in, gets one int8 shard back — float32
+    The host ships w 1-byte shards in, gets one 1-byte shard back — float32
     never crosses the PCIe/HBM boundary, which is the point of the
     reference's in-kernel reduce.  Off-TPU the same math runs as jnp.
     """
     w, rows, row_size = qs.shape
     if scales.ndim == 2:
         scales = scales[:, :, None]
-    if not (interpret or _on_tpu()):
+    if not (interpret or (_on_tpu() and _pallas_kind_ok(kind))):
         total = jnp.sum(qs.astype(jnp.float32) * scales, axis=0)
-        return _quant_math(total)
+        return _quant_math(total, kind)
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -139,7 +218,7 @@ def reduce_quantized_device(
     assert rows % BLOCK_ROWS == 0, rows
     grid = (rows // BLOCK_ROWS,)
     return pl.pallas_call(
-        _reduce_kernel,
+        functools.partial(_reduce_kernel, kind=kind),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -158,7 +237,7 @@ def reduce_quantized_device(
             pl.BlockSpec((BLOCK_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, row_size), jnp.int8),
+            jax.ShapeDtypeStruct((rows, row_size), _wire_jnp_dtype(kind)),
             jax.ShapeDtypeStruct((rows, 1), jnp.float32),
         ],
         interpret=interpret,
@@ -166,12 +245,14 @@ def reduce_quantized_device(
 
 
 @functools.partial(jax.jit, static_argnames=("n", "interpret"))
-def dequantize_int8_rowwise_device(
+def dequantize_rowwise_device(
     q: jax.Array, scales: jax.Array, n: int, interpret: bool = False
 ) -> jax.Array:
-    """(int8 [rows, row_size], f32 [rows, 1]) → float32 [n]."""
+    """(wire [rows, row_size], f32 [rows, 1]) → float32 [n].  The wire kind
+    is carried by ``q.dtype``."""
     rows, row_size = q.shape
-    if not (interpret or _on_tpu()):
+    kind = INT8 if q.dtype == jnp.int8 else FP8
+    if not (interpret or (_on_tpu() and _pallas_kind_ok(kind))):
         out = q.astype(jnp.float32) * scales
         return out.reshape(-1)[:n]
 
@@ -195,3 +276,16 @@ def dequantize_int8_rowwise_device(
         interpret=interpret,
     )(q, scales)
     return out.reshape(-1)[:n]
+
+
+# int8-named surface (round-1 API), kept for callers and parity docs
+def quantize_int8_rowwise_device(
+    flat: jax.Array, row_size: int = ROW_SIZE, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array]:
+    return quantize_rowwise_device(flat, row_size, INT8, interpret)
+
+
+def dequantize_int8_rowwise_device(
+    q: jax.Array, scales: jax.Array, n: int, interpret: bool = False
+) -> jax.Array:
+    return dequantize_rowwise_device(q, scales, n, interpret)
