@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from torchft_tpu.chaos import ChaosController, Failure, ThreadReplica
 from torchft_tpu.communicator import TCPCommunicator
 from torchft_tpu.ddp import ft_allreduce
 from torchft_tpu.lighthouse import LighthouseServer
@@ -62,7 +63,13 @@ class KillSignal(Exception):
     pass
 
 
-FAILURE_CLASSES = ("kill", "wedge", "commabort", "lighthouse")
+# CLI name -> typed failure (the controller's enum)
+FAILURE_CLASSES = {
+    "kill": Failure.KILL,
+    "wedge": Failure.DEADLOCK,
+    "commabort": Failure.COMM_ABORT,
+    "lighthouse": Failure.LIGHTHOUSE,
+}
 
 
 class SoakReplica:
@@ -78,7 +85,6 @@ class SoakReplica:
         self.wedge_secs = 0.0
         self.restarts = 0
         self.wedges = 0
-        self.comm_aborts = 0
         self.commits = 0
         self.attempts = 0
         self.final_state = None
@@ -175,44 +181,38 @@ def main() -> None:
     ]
 
     rng = random.Random(args.seed)
-    classes = [c.strip() for c in args.classes.split(",") if c.strip()]
-    assert classes and all(c in FAILURE_CLASSES for c in classes), (
-        f"--classes must name at least one of {FAILURE_CLASSES}: {args.classes!r}"
+    names = [c.strip() for c in args.classes.split(",") if c.strip()]
+    assert names and all(c in FAILURE_CLASSES for c in names), (
+        f"--classes must name at least one of {tuple(FAILURE_CLASSES)}: "
+        f"{args.classes!r}"
     )
-    counts = {c: 0 for c in classes}
+    classes = [FAILURE_CLASSES[c] for c in names]
 
-    def chaos() -> None:
-        while not stop.is_set():
-            time.sleep(rng.expovariate(1.0 / args.kill_every))
-            if stop.is_set():
-                return
-            victim = rng.choice(replicas)
-            cls = rng.choice(classes)
-            counts[cls] += 1
-            if cls == "kill":
-                victim.kill_flag.set()
-            elif cls == "wedge":
-                # sometimes longer than the 15s op timeout (peer-side abort
-                # + eviction), sometimes a mere straggler stall
-                victim.wedge_secs = rng.uniform(2.0, 22.0)
-                victim.wedge_flag.set()
-            elif cls == "lighthouse":
-                # kill + restart the coordination plane on the same port;
-                # in-flight quorums fail (connections are severed), replicas
-                # re-register against the empty soft state next round
-                lh["srv"].shutdown()
-                time.sleep(1.0)
-                lh["srv"] = make_lighthouse(f"127.0.0.1:{lh_port}")
-            else:  # commabort
-                comm = getattr(victim, "comm", None)
-                if comm is None:
-                    counts[cls] -= 1  # victim not initialized yet: no-op
-                    continue
-                victim.comm_aborts += 1
-                comm.abort("chaos: injected comm failure")
-            print(f"[chaos] {cls} replica {victim.idx} ({counts})", flush=True)
+    def restart_lighthouse() -> None:
+        # kill + restart the coordination plane on the same port;
+        # in-flight quorums fail (connections are severed), replicas
+        # re-register against the empty soft state next round
+        lh["srv"].shutdown()
+        time.sleep(1.0)
+        lh["srv"] = make_lighthouse(f"127.0.0.1:{lh_port}")
 
-    chaos_thread = threading.Thread(target=chaos, daemon=True)
+    controller = ChaosController(
+        [ThreadReplica(f"replica_{r.idx}", r) for r in replicas],
+        lighthouse_restart=restart_lighthouse,
+        rng=rng,
+    )
+
+    chaos_thread = threading.Thread(
+        target=controller.run_poisson,
+        args=(classes, args.kill_every, stop),
+        kwargs=dict(
+            on_inject=lambda ev: print(
+                f"[chaos] {ev.failure.value} {ev.victim or 'fleet'}",
+                flush=True,
+            )
+        ),
+        daemon=True,
+    )
     chaos_thread.start()
 
     with ThreadPoolExecutor(max_workers=args.replicas) as pool:
@@ -224,6 +224,9 @@ def main() -> None:
 
     lh["srv"].shutdown()
 
+    counts = {f.value: 0 for f in classes}
+    for ev in controller.events:
+        counts[ev.failure.value] += 1
     total_commits = sum(r.commits for r in replicas)
     total_attempts = sum(r.attempts for r in replicas)
     print(

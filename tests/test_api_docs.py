@@ -24,3 +24,45 @@ def test_coordination_surface_documented() -> None:
         obj = getattr(coordination, name)
         if inspect.isclass(obj) or inspect.isfunction(obj):
             assert (obj.__doc__ or "").strip(), f"{name} undocumented"
+
+
+def test_native_stub_covers_public_surface() -> None:
+    """``native.pyi`` (the ``_torchft.pyi`` analog) must type every public
+    class and its public methods, so the stub can't silently drift from
+    the module."""
+    import ast
+    import os
+
+    from torchft_tpu import native
+
+    stub_path = os.path.join(os.path.dirname(native.__file__), "native.pyi")
+    tree = ast.parse(open(stub_path).read())
+    stub_names = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.ClassDef)):
+            stub_names.add(node.name)
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        stub_names.add(f"{node.name}.{sub.name}")
+
+    missing = []
+    for name, obj in vars(native).items():
+        if name.startswith("_") or not inspect.isclass(obj):
+            continue
+        if obj.__module__ != "torchft_tpu.native":
+            continue
+        if name not in stub_names:
+            missing.append(name)
+            continue
+        for meth, fn in vars(obj).items():
+            if meth.startswith("_"):
+                continue
+            if inspect.isfunction(fn) or isinstance(fn, property):
+                if f"{name}.{meth}" not in stub_names:
+                    missing.append(f"{name}.{meth}")
+    for fname in ("available", "quantize_rowwise_native",
+                  "dequantize_rowwise_native", "reduce_rowwise_native"):
+        if fname not in stub_names:
+            missing.append(fname)
+    assert not missing, f"native.pyi missing: {missing}"

@@ -48,17 +48,29 @@ REPO = Path(__file__).parent.parent
 
 
 class _ChaosReplica:
-    """Thread replica with wedge/comm-abort hooks (soak.py's shape, made
-    deterministic for CI)."""
+    """Thread replica with the cooperative hook shape
+    :class:`~torchft_tpu.chaos.ThreadReplica` adapts (kill/wedge flags +
+    live ``comm``), plus deterministic at-step triggers for CI."""
 
-    def __init__(self, idx: int, lighthouse_addr: str, steps: int, timeout_s: float):
+    def __init__(
+        self,
+        idx: int,
+        lighthouse_addr: str,
+        steps: int,
+        timeout_s: float,
+        step_time_s: float = 0.0,
+    ):
         self.idx = idx
         self.steps = steps
         self.timeout_s = timeout_s
+        self.step_time_s = step_time_s
         self.lighthouse_addr = lighthouse_addr
         self.wedge_at: Optional[int] = None
         self.wedge_secs = 0.0
         self.abort_at: Optional[int] = None
+        self.kill_flag = threading.Event()
+        self.wedge_flag = threading.Event()
+        self.comm = None
         self.failed_steps = 0
         self.progress = 0  # latest committed step, for outside observers
         self.final: Optional[Dict] = None
@@ -75,6 +87,7 @@ class _ChaosReplica:
         tx = optax.sgd(0.05)
         holder = {"params": params, "opt_state": tx.init(params)}
         comm = TCPCommunicator(timeout_s=self.timeout_s)
+        self.comm = comm
         manager = Manager(
             comm=comm,
             load_state_dict=lambda s: holder.update(s),
@@ -88,12 +101,19 @@ class _ChaosReplica:
         opt = OptimizerWrapper(manager, tx)
         try:
             while manager.current_step() < self.steps:
+                if self.step_time_s:
+                    # paced so an outside controller's inject/await window
+                    # can't be outrun by a sprinting replica
+                    time.sleep(self.step_time_s)
                 step = manager.current_step()
                 opt.start_step()
                 if self.wedge_at is not None and step == self.wedge_at:
                     self.wedge_at = None
                     # deadlock-class: park after joining the quorum; peers
                     # block in the ring until their op timeout fires
+                    time.sleep(self.wedge_secs)
+                if self.wedge_flag.is_set():
+                    self.wedge_flag.clear()
                     time.sleep(self.wedge_secs)
                 if self.abort_at is not None and step == self.abort_at:
                     self.abort_at = None
@@ -138,25 +158,62 @@ def lighthouse():
 
 
 def test_wedged_replica_evicted_then_rejoins(lighthouse) -> None:
-    """Wedge > op-timeout: the healthy peer's collective aborts, the next
-    quorum proceeds without the wedged member (which still heartbeats!),
-    and when it wakes it rejoins and heals to the fleet's step."""
+    """Wedge > op-timeout, scripted through the ChaosController: the
+    healthy peer's collective aborts, the next quorum proceeds without the
+    wedged member (which still heartbeats!), and ``await_heal`` observes it
+    rejoin and commit again."""
+    from torchft_tpu.chaos import ChaosController, Failure, ThreadReplica
+
     addr = lighthouse.local_address()
-    r0 = _ChaosReplica(0, addr, steps=25, timeout_s=2.0)
-    r1 = _ChaosReplica(1, addr, steps=25, timeout_s=2.0)
-    r1.wedge_at, r1.wedge_secs = 5, 8.0  # 4x the op timeout
-    _run_fleet([r0, r1])
+    r0 = _ChaosReplica(0, addr, steps=25, timeout_s=2.0, step_time_s=0.1)
+    r1 = _ChaosReplica(1, addr, steps=25, timeout_s=2.0, step_time_s=0.1)
+    victim = ThreadReplica("r1", r1)
+    controller = ChaosController([ThreadReplica("r0", r0), victim])
+
+    threads = [
+        threading.Thread(target=r.run, daemon=True) for r in (r0, r1)
+    ]
+    for t in threads:
+        t.start()
+    # let the fleet make real progress, then wedge r1 for 4x the op timeout
+    assert controller.await_progress(victim, beyond=4, timeout_s=60.0)
+    controller.inject(Failure.DEADLOCK, victim=victim, secs=8.0)
+    assert controller.await_heal(victim, timeout_s=90.0)
+    end = time.monotonic() + 120
+    for t in threads:
+        t.join(timeout=max(1.0, end - time.monotonic()))
+    for r in (r0, r1):
+        assert r.error is None, f"replica {r.idx} died: {r.error!r}"
+        assert r.final is not None
     # the healthy peer had to abort at least one collective on the wedge
     assert r0.failed_steps >= 1
     np.testing.assert_array_equal(r0.final["params"]["w"], r1.final["params"]["w"])
+    assert [e.failure for e in controller.events] == [Failure.DEADLOCK]
 
 
 def test_comm_abort_recovers_without_restart(lighthouse) -> None:
+    from torchft_tpu.chaos import ChaosController, Failure, ThreadReplica
+
     addr = lighthouse.local_address()
-    r0 = _ChaosReplica(0, addr, steps=20, timeout_s=5.0)
-    r1 = _ChaosReplica(1, addr, steps=20, timeout_s=5.0)
-    r1.abort_at = 4
-    _run_fleet([r0, r1])
+    r0 = _ChaosReplica(0, addr, steps=20, timeout_s=5.0, step_time_s=0.1)
+    r1 = _ChaosReplica(1, addr, steps=20, timeout_s=5.0, step_time_s=0.1)
+    victim = ThreadReplica("r1", r1)
+    controller = ChaosController([ThreadReplica("r0", r0), victim])
+    threads = [
+        threading.Thread(target=r.run, daemon=True) for r in (r0, r1)
+    ]
+    for t in threads:
+        t.start()
+    assert controller.await_progress(victim, beyond=3, timeout_s=60.0)
+    controller.inject(Failure.COMM_ABORT, victim=victim)
+    # healed = commits again after the abort, with NO process restart
+    assert controller.await_heal(victim, timeout_s=90.0)
+    end = time.monotonic() + 120
+    for t in threads:
+        t.join(timeout=max(1.0, end - time.monotonic()))
+    for r in (r0, r1):
+        assert r.error is None, f"replica {r.idx} died: {r.error!r}"
+        assert r.final is not None
     assert r1.failed_steps >= 1  # the aborted step must not commit
     np.testing.assert_array_equal(r0.final["params"]["w"], r1.final["params"]["w"])
 
@@ -193,6 +250,21 @@ def test_sigstop_process_wedge_evicts_and_heals(tmp_path) -> None:
     )
     runner = threading.Thread(target=supervisor.run, daemon=True)
     runner.start()
+    from torchft_tpu.chaos import ChaosController, Failure, ProcessReplica
+
+    def _victim_step() -> int:
+        # committed step scraped from the victim's training log
+        # ("step N loss ..." per step, "FINAL step=N ..." at completion)
+        try:
+            m = re.findall(r"step[= ](\d+)", logs[1].read_text())
+            return int(m[-1]) if m else 0
+        except OSError:
+            return 0
+
+    victim = ProcessReplica(
+        "rg1", supervisor, replica_group_id=1, progress_fn=_victim_step
+    )
+    controller = ChaosController([victim])
     try:
         # let the fleet form and make progress, then freeze replica 1
         deadline = time.monotonic() + 120
@@ -204,9 +276,10 @@ def test_sigstop_process_wedge_evicts_and_heals(tmp_path) -> None:
         else:
             pytest.fail("fleet never formed")
         time.sleep(3.0)
-        assert supervisor.kill(1, sig=signal.SIGSTOP)
-        time.sleep(12.0)  # > comm timeout + heartbeat timeout: eviction
-        assert supervisor.kill(1, sig=signal.SIGCONT)
+        # freeze > comm timeout + heartbeat timeout (eviction), auto-thaw
+        controller.inject(Failure.DEADLOCK, victim=victim, secs=12.0)
+        # healed = the victim commits again after the thaw
+        assert controller.await_heal(victim, timeout_s=120.0)
         runner.join(timeout=180)
         assert not runner.is_alive(), "fleet did not finish"
     finally:

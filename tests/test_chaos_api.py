@@ -1,0 +1,163 @@
+"""ChaosController unit tests against scripted fake handles (the
+programmable surface itself; end-to-end injection is covered by
+``test_chaos.py`` and ``scripts/soak.py``)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from torchft_tpu.chaos import (
+    ChaosController,
+    Failure,
+    ProcessReplica,
+    ReplicaHandle,
+    ThreadReplica,
+)
+
+
+class _FakeHandle(ReplicaHandle):
+    def __init__(self, name, supported):
+        self.name = name
+        self._supported = supported
+        self.injected = []
+        self._progress = 0
+
+    def supports(self, failure):
+        return failure in self._supported
+
+    def inject(self, failure, **kw):
+        self.injected.append((failure, kw))
+
+    def progress(self):
+        return self._progress
+
+
+def test_inject_explicit_victim_and_log():
+    h = _FakeHandle("a", {Failure.KILL})
+    c = ChaosController([h])
+    out = c.inject(Failure.KILL, victim=h)
+    assert out is h
+    assert h.injected == [(Failure.KILL, {})]
+    assert c.events[0].failure is Failure.KILL
+    assert c.events[0].victim == "a"
+
+
+def test_random_victim_restricted_to_supporting_handles():
+    kill_only = _FakeHandle("k", {Failure.KILL})
+    seg_only = _FakeHandle("s", {Failure.SEGFAULT})
+    c = ChaosController([kill_only, seg_only], rng=random.Random(0))
+    for _ in range(5):
+        assert c.inject(Failure.SEGFAULT) is seg_only
+    assert not kill_only.injected
+
+
+def test_inject_unsupported_raises():
+    c = ChaosController([_FakeHandle("a", {Failure.KILL})])
+    with pytest.raises(ValueError, match="no replica supports"):
+        c.inject(Failure.COMM_ABORT)
+
+
+def test_lighthouse_failure_uses_callback():
+    calls = []
+    c = ChaosController([], lighthouse_restart=lambda: calls.append(1))
+    assert c.inject(Failure.LIGHTHOUSE) is None
+    assert calls == [1]
+    c2 = ChaosController([])
+    with pytest.raises(ValueError, match="lighthouse_restart"):
+        c2.inject(Failure.LIGHTHOUSE)
+
+
+def test_await_heal_observes_progress():
+    h = _FakeHandle("a", {Failure.KILL})
+    h._progress = 7
+    c = ChaosController([h])
+
+    def bump():
+        time.sleep(0.2)
+        h._progress = 8
+
+    threading.Thread(target=bump, daemon=True).start()
+    assert c.await_heal(h, timeout_s=5.0)
+    assert not c.await_progress(h, beyond=8, timeout_s=0.3)
+
+
+def test_poisson_loop_counts_and_stops():
+    h = _FakeHandle("a", {Failure.KILL, Failure.COMM_ABORT})
+    c = ChaosController([h], rng=random.Random(1))
+    stop = threading.Event()
+    seen = []
+    result = {}
+
+    def run():
+        result["counts"] = c.run_poisson(
+            [Failure.KILL, Failure.COMM_ABORT],
+            mtbf_s=0.02,
+            stop=stop,
+            on_inject=seen.append,
+        )
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    stop.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    counts = result["counts"]
+    assert sum(counts.values()) >= 3
+    assert len(seen) == sum(counts.values()) == len(c.events)
+
+
+def test_thread_replica_adapter_arms_hooks():
+    class Obj:
+        def __init__(self):
+            self.kill_flag = threading.Event()
+            self.wedge_flag = threading.Event()
+            self.wedge_secs = 0.0
+            self.comm = None
+            self.commits = 3
+
+    obj = Obj()
+    tr = ThreadReplica("t", obj)
+    tr.inject(Failure.KILL)
+    assert obj.kill_flag.is_set()
+    tr.inject(Failure.DEADLOCK, secs=4.5)
+    assert obj.wedge_flag.is_set() and obj.wedge_secs == 4.5
+    with pytest.raises(RuntimeError, match="no live communicator"):
+        tr.inject(Failure.COMM_ABORT)
+    assert tr.progress() == 3
+    with pytest.raises(ValueError):
+        tr.inject(Failure.SEGFAULT)
+
+
+def test_process_replica_adapter_signals():
+    import signal
+
+    class FakeSupervisor:
+        def __init__(self):
+            self.kills = []
+
+        def kill(self, gid, sig):
+            self.kills.append((gid, sig))
+            return True
+
+    sup = FakeSupervisor()
+    pr = ProcessReplica("p", sup, replica_group_id=2, progress_fn=lambda: 9)
+    pr.inject(Failure.KILL)
+    pr.inject(Failure.SEGFAULT)
+    pr.inject(Failure.DEADLOCK, secs=0.05)
+    time.sleep(0.3)  # the thaw timer must fire
+    assert (2, signal.SIGKILL) in sup.kills
+    assert (2, signal.SIGSEGV) in sup.kills
+    assert (2, signal.SIGSTOP) in sup.kills
+    assert (2, signal.SIGCONT) in sup.kills
+    assert pr.progress() == 9
+
+    class DeadSupervisor(FakeSupervisor):
+        def kill(self, gid, sig):
+            return False
+
+    dead = ProcessReplica("d", DeadSupervisor(), 0)
+    with pytest.raises(RuntimeError, match="no live process"):
+        dead.inject(Failure.KILL)

@@ -26,7 +26,7 @@ import os
 import threading
 from concurrent.futures import Future
 from multiprocessing import shared_memory
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -213,8 +213,15 @@ def _worker_main(cmd_pipe, out_pipe, backend: str, timeout_s: float) -> None:
                 raise CommunicatorError(f"unknown baby op {op}")
             out_pipe.send((op_id, result))
         except Exception as e:  # noqa: BLE001 — ship to the parent
+            # preserve the framework's error types across the pipe so the
+            # caller's handling doesn't depend on payload size (the shm
+            # paths raise in the child, the pickle paths in the parent)
+            if isinstance(e, (CommunicatorError, CommunicatorAborted)):
+                shipped: Exception = e
+            else:
+                shipped = RuntimeError(str(e))
             try:
-                out_pipe.send((op_id, RuntimeError(str(e))))
+                out_pipe.send((op_id, shipped))
             except (OSError, ValueError):
                 break
     shms.close()
@@ -425,11 +432,15 @@ class BabyCommunicator(Communicator):
             np.copyto(view, a)
         work = self._submit(op, dict(shm=shm.name, metas=metas, **extra))
 
+        release_once = self._release_once(shm)
+
         def _land(result: object):
             if isinstance(result, dict) and "meta" in result:
                 # reduce_scatter: the child re-described the (smaller) shard
                 (out,) = _views(shm.buf, [result["meta"]])
-                return out.copy()
+                out = out.copy()
+                release_once()
+                return out
             views = _views(shm.buf, metas)
             if in_place:
                 for a, v in zip(arrays, views):
@@ -437,14 +448,26 @@ class BabyCommunicator(Communicator):
                 out_list = arrays
             else:
                 out_list = [v.copy() for v in views]
+            # release BEFORE the result is delivered: a waiter that submits
+            # its next op the instant wait() returns must find this arena in
+            # the free list (done-callbacks run after waiters wake)
+            release_once()
             return out_list[0] if single else out_list
 
         landed = work.then(_land)
-        # release on ANY outcome — a failed op must not leak the arena
-        landed.future().add_done_callback(
-            lambda _f: self._arenas.release(shm)
-        )
+        # failure path (and belt-and-braces): never leak the arena
+        landed.future().add_done_callback(lambda _f: release_once())
         return landed
+
+    def _release_once(self, shm) -> Callable[[], None]:
+        released = threading.Event()
+
+        def _release() -> None:
+            if not released.is_set():
+                released.set()
+                self._arenas.release(shm)
+
+        return _release
 
     def allreduce(
         self,
@@ -534,6 +557,7 @@ class BabyCommunicator(Communicator):
             # parent pays one copy into the caller's buffer (the pickle
             # path pays serialize + deserialize + copy)
             shm = self._arenas.acquire(out.nbytes)
+            release_once = self._release_once(shm)
             work = self._submit(
                 "recv_bytes_shm",
                 dict(shm=shm.name, cap=out.nbytes, src=src, tag=tag),
@@ -544,12 +568,11 @@ class BabyCommunicator(Communicator):
                 out.reshape(-1).view(np.uint8)[:n] = np.frombuffer(
                     shm.buf, np.uint8, count=n
                 )
+                release_once()
                 return n
 
             landed = work.then(_land_shm)
-            landed.future().add_done_callback(
-                lambda _f: self._arenas.release(shm)
-            )
+            landed.future().add_done_callback(lambda _f: release_once())
             return landed
         work = self._submit("recv_bytes", dict(src=src, tag=tag))
 

@@ -1,0 +1,289 @@
+"""Programmable failure injection: the Monarch FailureController analog.
+
+The reference's Monarch example supervises replicas as actors and injects
+typed failures programmatically — SEGFAULT / KILL_PROC / COMMS / DEADLOCK /
+KILL_SLURM (``/root/reference/examples/monarch/utils/failure.py:24-95``).
+This module gives torchft_tpu the same scriptable surface over both replica
+planes the framework runs on:
+
+- **process plane** (:class:`ProcessReplica`): replica groups as OS
+  processes under :class:`~torchft_tpu.launcher.ReplicaSupervisor` —
+  failures are real signals (SIGKILL / SIGSEGV / SIGSTOP-freeze).
+- **thread plane** (:class:`ThreadReplica`): replicas as threads in one
+  process (the CI harness shape, ``tests/test_manager_integ.py``) —
+  failures arm the replica loop's cooperative hooks (kill flag, wedge,
+  communicator abort).
+
+:class:`ChaosController` is the scenario driver: ``inject()`` delivers a
+typed failure to a chosen (or random) victim, ``await_heal()`` blocks until
+the victim commits again, and ``run_poisson()`` is the randomized soak
+loop (``scripts/soak.py`` runs on it; chaos tests script it directly).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import random
+import signal
+import threading
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class Failure(enum.Enum):
+    """Failure classes, matching the reference's enum
+    (``examples/monarch/utils/failure.py:24-33``) plus the
+    coordination-plane death the reference leaves to manual chaos."""
+
+    KILL = "kill"  # hard process/thread death; supervisor restarts it
+    SEGFAULT = "segfault"  # SIGSEGV (process plane)
+    DEADLOCK = "deadlock"  # wedge mid-step; peers must evict via timeouts
+    COMM_ABORT = "commabort"  # comms die under the replica (NIC analog)
+    LIGHTHOUSE = "lighthouse"  # coordination plane dies + restarts
+
+
+@dataclass
+class ChaosEvent:
+    ts: float
+    failure: Failure
+    victim: Optional[str]
+    detail: Dict[str, Any] = field(default_factory=dict)
+
+
+class ReplicaHandle(ABC):
+    """One injectable replica.  ``progress()`` must be monotone in
+    committed steps — ``await_heal`` is defined in terms of it."""
+
+    name: str
+
+    @abstractmethod
+    def supports(self, failure: Failure) -> bool: ...
+
+    @abstractmethod
+    def inject(self, failure: Failure, **kw: Any) -> None: ...
+
+    @abstractmethod
+    def progress(self) -> int: ...
+
+
+class ThreadReplica(ReplicaHandle):
+    """Adapter over a thread-plane replica object exposing the cooperative
+    hook shape used by the soak/chaos harnesses:
+
+    - ``kill_flag: threading.Event`` — raise-and-restart on next step
+    - ``wedge_flag: threading.Event`` + ``wedge_secs: float`` — park
+      mid-step after joining the quorum
+    - ``comm`` — live communicator with ``abort(reason)``
+    - ``commits: int`` (or ``progress``) — monotone committed-step count
+    """
+
+    def __init__(self, name: str, obj: Any) -> None:
+        self.name = name
+        self._obj = obj
+
+    def supports(self, failure: Failure) -> bool:
+        return failure in (Failure.KILL, Failure.DEADLOCK, Failure.COMM_ABORT)
+
+    def inject(self, failure: Failure, **kw: Any) -> None:
+        if failure is Failure.KILL:
+            self._obj.kill_flag.set()
+        elif failure is Failure.DEADLOCK:
+            self._obj.wedge_secs = float(kw.get("secs", 10.0))
+            self._obj.wedge_flag.set()
+        elif failure is Failure.COMM_ABORT:
+            comm = getattr(self._obj, "comm", None)
+            if comm is None:
+                raise RuntimeError(f"{self.name}: no live communicator yet")
+            comm.abort(str(kw.get("reason", "chaos: injected comm failure")))
+        else:
+            raise ValueError(f"thread plane cannot inject {failure}")
+
+    def progress(self) -> int:
+        return int(
+            getattr(self._obj, "commits", getattr(self._obj, "progress", 0))
+        )
+
+
+class ProcessReplica(ReplicaHandle):
+    """Adapter over one replica group of a
+    :class:`~torchft_tpu.launcher.ReplicaSupervisor` — failures are real
+    signals against the live process; the supervisor's restart/standby
+    machinery is the recovery under test.
+
+    ``progress_fn`` reads the group's committed step from the outside
+    (an event log, the lighthouse status page, a log scraper).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        supervisor: Any,
+        replica_group_id: int,
+        progress_fn: Callable[[], int] = lambda: 0,
+    ) -> None:
+        self.name = name
+        self._supervisor = supervisor
+        self._gid = replica_group_id
+        self._progress_fn = progress_fn
+
+    def supports(self, failure: Failure) -> bool:
+        return failure in (Failure.KILL, Failure.SEGFAULT, Failure.DEADLOCK)
+
+    def inject(self, failure: Failure, **kw: Any) -> None:
+        if failure is Failure.KILL:
+            ok = self._supervisor.kill(self._gid, sig=signal.SIGKILL)
+        elif failure is Failure.SEGFAULT:
+            ok = self._supervisor.kill(self._gid, sig=signal.SIGSEGV)
+        elif failure is Failure.DEADLOCK:
+            # the truest deadlock: every thread frozen, heartbeats included;
+            # thaw after ``secs`` so the victim rejoins and heals
+            secs = float(kw.get("secs", 12.0))
+            ok = self._supervisor.kill(self._gid, sig=signal.SIGSTOP)
+            if ok:
+                timer = threading.Timer(
+                    secs,
+                    lambda: self._supervisor.kill(
+                        self._gid, sig=signal.SIGCONT
+                    ),
+                )
+                timer.daemon = True
+                timer.start()
+        else:
+            raise ValueError(f"process plane cannot inject {failure}")
+        if not ok:
+            raise RuntimeError(
+                f"{self.name}: no live process to inject {failure.value}"
+            )
+
+    def progress(self) -> int:
+        return int(self._progress_fn())
+
+
+class ChaosController:
+    """Scriptable failure scenarios over a set of replica handles.
+
+    ``lighthouse_restart`` (when provided) implements
+    :attr:`Failure.LIGHTHOUSE`: it must tear down the coordination plane
+    and bring it back (same address, empty soft state).
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[ReplicaHandle],
+        lighthouse_restart: Optional[Callable[[], None]] = None,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self.replicas = list(replicas)
+        self._lighthouse_restart = lighthouse_restart
+        self._rng = rng or random.Random()
+        self.events: List[ChaosEvent] = []
+
+    # -- injection ---------------------------------------------------------
+
+    def inject(
+        self,
+        failure: Failure,
+        victim: Optional[ReplicaHandle] = None,
+        **kw: Any,
+    ) -> Optional[ReplicaHandle]:
+        """Deliver ``failure``; picks a random supporting victim when none
+        is given.  Returns the victim (None for fleet-level failures)."""
+        if failure is Failure.LIGHTHOUSE:
+            if self._lighthouse_restart is None:
+                raise ValueError("no lighthouse_restart configured")
+            self._lighthouse_restart()
+            self.events.append(
+                ChaosEvent(time.time(), failure, victim=None, detail=kw)
+            )
+            logger.info("chaos: lighthouse restarted")
+            return None
+        if victim is None:
+            candidates = [r for r in self.replicas if r.supports(failure)]
+            if not candidates:
+                raise ValueError(f"no replica supports {failure}")
+            victim = self._rng.choice(candidates)
+        victim.inject(failure, **kw)
+        detail = dict(kw)
+        detail["progress_at_inject"] = victim.progress()
+        self.events.append(
+            ChaosEvent(time.time(), failure, victim=victim.name, detail=detail)
+        )
+        logger.info("chaos: %s -> %s %s", failure.value, victim.name, kw)
+        return victim
+
+    # -- observation -------------------------------------------------------
+
+    def await_progress(
+        self,
+        victim: ReplicaHandle,
+        beyond: int,
+        timeout_s: float,
+        poll_s: float = 0.1,
+    ) -> bool:
+        """Block until ``victim.progress() > beyond`` (False on timeout)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if victim.progress() > beyond:
+                return True
+            time.sleep(poll_s)
+        return victim.progress() > beyond
+
+    def await_heal(
+        self, victim: ReplicaHandle, timeout_s: float = 60.0
+    ) -> bool:
+        """Block until the victim commits beyond its progress at the LAST
+        injection against it, plus one step of slack — thread-plane
+        failures are armed via flags consumed at the victim's next step
+        boundary, so the step in flight at inject time may still commit
+        before the failure lands and must not count as healed."""
+        baseline = victim.progress()
+        slack = 0
+        for ev in reversed(self.events):
+            if ev.victim == victim.name:
+                baseline = max(
+                    baseline, int(ev.detail.get("progress_at_inject", 0))
+                )
+                slack = 1  # the step in flight at inject time
+                break
+        return self.await_progress(victim, baseline + slack, timeout_s)
+
+    # -- randomized soak ---------------------------------------------------
+
+    def run_poisson(
+        self,
+        classes: Sequence[Failure],
+        mtbf_s: float,
+        stop: threading.Event,
+        on_inject: Optional[Callable[[ChaosEvent], None]] = None,
+        deadlock_secs: Callable[[], float] | None = None,
+    ) -> Dict[Failure, int]:
+        """Inject failures on a Poisson schedule until ``stop`` — the soak
+        loop (``scripts/soak.py``).  Returns per-class injection counts."""
+        counts = {c: 0 for c in classes}
+        while not stop.is_set():
+            stop.wait(self._rng.expovariate(1.0 / mtbf_s))
+            if stop.is_set():
+                break
+            cls = self._rng.choice(list(classes))
+            kw: Dict[str, Any] = {}
+            if cls is Failure.DEADLOCK:
+                kw["secs"] = (
+                    deadlock_secs() if deadlock_secs
+                    else self._rng.uniform(2.0, 22.0)
+                )
+            try:
+                self.inject(cls, **kw)
+            except (RuntimeError, ValueError) as e:
+                # a victim with no live comm yet (etc.) is a no-op draw,
+                # not a soak failure
+                logger.info("chaos: %s skipped (%s)", cls.value, e)
+                continue
+            counts[cls] += 1
+            if on_inject:
+                on_inject(self.events[-1])
+        return counts

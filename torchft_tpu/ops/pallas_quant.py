@@ -91,6 +91,7 @@ def _on_tpu() -> bool:
 
 
 _KIND_OK: Dict[str, bool] = {}
+_KIND_OK_LOCK = __import__("threading").Lock()
 
 
 def _pallas_kind_ok(kind: str) -> bool:
@@ -98,33 +99,33 @@ def _pallas_kind_ok(kind: str) -> bool:
     universal; fp8 conversion support varies by TPU generation.  Probes
     BOTH kernels gated on it — the quantize store and the structurally
     different reduce ([w, rows, R] fp8 loads + multiply) — because either
-    can fail independently."""
-    if kind in _KIND_OK:
-        return _KIND_OK[kind]
+    can fail independently.  The verdict is published only AFTER both
+    probes finish (under a lock): concurrent collectives must never see a
+    provisional True and take an un-lowerable Pallas branch."""
     if kind == INT8:
-        _KIND_OK[kind] = True
         return True
-    try:
-        x = jnp.ones((BLOCK_ROWS * ROW_SIZE,), jnp.float32)
-        jax.jit(
-            functools.partial(
-                _pallas_quantize, row_size=ROW_SIZE, kind=kind, interpret=False
-            )
-        ).lower(x).compile()
-        qs = jnp.zeros((2, BLOCK_ROWS, ROW_SIZE), _wire_jnp_dtype(kind))
-        sc = jnp.ones((2, BLOCK_ROWS, 1), jnp.float32)
-        _KIND_OK[kind] = True  # allow reduce_quantized_device to take the
-        # pallas branch while we compile-probe it
+    with _KIND_OK_LOCK:
+        if kind in _KIND_OK:
+            return _KIND_OK[kind]
         try:
+            x = jnp.ones((BLOCK_ROWS * ROW_SIZE,), jnp.float32)
             jax.jit(
-                functools.partial(reduce_quantized_device, kind=kind)
+                functools.partial(
+                    _pallas_quantize,
+                    row_size=ROW_SIZE,
+                    kind=kind,
+                    interpret=False,
+                )
+            ).lower(x).compile()
+            qs = jnp.zeros((2, BLOCK_ROWS, ROW_SIZE), _wire_jnp_dtype(kind))
+            sc = jnp.ones((2, BLOCK_ROWS, 1), jnp.float32)
+            jax.jit(
+                functools.partial(_pallas_reduce, kind=kind, interpret=False)
             ).lower(qs, sc).compile()
-        except Exception:
+            _KIND_OK[kind] = True
+        except Exception:  # noqa: BLE001 — any lowering failure → jnp fallback
             _KIND_OK[kind] = False
-            raise
-    except Exception:  # noqa: BLE001 — any lowering failure → jnp fallback
-        _KIND_OK[kind] = False
-    return _KIND_OK[kind]
+        return _KIND_OK[kind]
 
 
 def _pallas_quantize(
@@ -189,31 +190,13 @@ def _reduce_kernel(qs_ref, s_ref, q_ref, out_s_ref, *, kind: str):
     out_s_ref[:] = scale
 
 
-@functools.partial(jax.jit, static_argnames=("kind", "interpret"))
-def reduce_quantized_device(
-    qs: jax.Array,
-    scales: jax.Array,
-    kind: str = INT8,
-    interpret: bool = False,
+def _pallas_reduce(
+    qs: jax.Array, scales: jax.Array, kind: str, interpret: bool
 ) -> Tuple[jax.Array, jax.Array]:
-    """Fused dequant-sum-requant of ``w`` quantized contributions ON DEVICE:
-    qs wire [w, rows, row_size], scales f32 [w, rows, 1] → (wire [rows,
-    row_size], f32 [rows, 1]) of the float32 sum.
-
-    The host ships w 1-byte shards in, gets one 1-byte shard back — float32
-    never crosses the PCIe/HBM boundary, which is the point of the
-    reference's in-kernel reduce.  Off-TPU the same math runs as jnp.
-    """
-    w, rows, row_size = qs.shape
-    if scales.ndim == 2:
-        scales = scales[:, :, None]
-    if not (interpret or (_on_tpu() and _pallas_kind_ok(kind))):
-        total = jnp.sum(qs.astype(jnp.float32) * scales, axis=0)
-        return _quant_math(total, kind)
-
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    w, rows, row_size = qs.shape
     # rows were padded to BLOCK_ROWS by the quantizer; guard anyway
     assert rows % BLOCK_ROWS == 0, rows
     grid = (rows // BLOCK_ROWS,)
@@ -242,6 +225,29 @@ def reduce_quantized_device(
         ],
         interpret=interpret,
     )(qs, scales)
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "interpret"))
+def reduce_quantized_device(
+    qs: jax.Array,
+    scales: jax.Array,
+    kind: str = INT8,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused dequant-sum-requant of ``w`` quantized contributions ON DEVICE:
+    qs wire [w, rows, row_size], scales f32 [w, rows, 1] → (wire [rows,
+    row_size], f32 [rows, 1]) of the float32 sum.
+
+    The host ships w 1-byte shards in, gets one 1-byte shard back — float32
+    never crosses the PCIe/HBM boundary, which is the point of the
+    reference's in-kernel reduce.  Off-TPU the same math runs as jnp.
+    """
+    if scales.ndim == 2:
+        scales = scales[:, :, None]
+    if not (interpret or (_on_tpu() and _pallas_kind_ok(kind))):
+        total = jnp.sum(qs.astype(jnp.float32) * scales, axis=0)
+        return _quant_math(total, kind)
+    return _pallas_reduce(qs, scales, kind, interpret)
 
 
 @functools.partial(jax.jit, static_argnames=("n", "interpret"))
