@@ -208,7 +208,9 @@ def _sync(tree: Any) -> None:
     jax.device_get(leaf.ravel()[0])
 
 
-def _build_model(sizes: Dict[str, int], fleet: bool = False):
+def _build_model(
+    sizes: Dict[str, int], fleet: bool = False, remat_mode: str = "none"
+):
     import jax.numpy as jnp
 
     from torchft_tpu.models.llama import Llama, LlamaConfig
@@ -226,9 +228,35 @@ def _build_model(sizes: Dict[str, int], fleet: bool = False):
         ffn_hidden=dim * 3,
         max_seq_len=sizes[f"{prefix}seq"],
         dtype=jnp.bfloat16,
-        remat=bool(not fleet and sizes.get("remat")),
+        remat_mode="none" if fleet else remat_mode,
     )
     return Llama(config), config
+
+
+# extra hardware FLOPs each remat policy re-runs in the backward, as a
+# multiplier on the 6N/token convention (fwd 2N + bwd 4N): "layer" re-runs
+# the whole forward (+2N -> 8/6); "ffn" re-runs the FFN forward (~78% of
+# the weight-matmul FLOPs at ffn_hidden = 3*dim, GQA/4 -> ~7.56/6);
+# "attn" re-runs the attention forward (~22% + scores -> ~6.7/6)
+_REMAT_HW_FACTOR = {
+    "none": 1.0,
+    "attn": 6.7 / 6.0,
+    "ffn": 7.56 / 6.0,
+    "layer": 8.0 / 6.0,
+}
+
+
+def _phase_a_modes(sizes: Dict[str, int]) -> List[str]:
+    """Remat-mode preference for phase A.  Explicit env wins; otherwise,
+    when remat is requested, try cheapest-recompute first and let the OOM
+    fallback in :func:`run_single` walk toward "layer" — the mode that is
+    known to fit.  Recompute tax: attn ~12%, ffn ~26%, layer ~33%."""
+    env = os.environ.get("TPUFT_BENCH_REMAT_MODE", "")
+    if env:
+        return [env]
+    if not sizes.get("remat"):
+        return ["none"]
+    return ["attn", "ffn", "layer"]
 
 
 # --------------------------------------------------------------------------
@@ -860,6 +888,37 @@ def _heal_breakdown(
 
 
 def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
+    """Phase A with remat-mode walk: cheaper-recompute modes are tried
+    first and an activation OOM falls back toward full per-layer remat."""
+    modes = _phase_a_modes(sizes)
+    last_err: Optional[BaseException] = None
+    for i, mode in enumerate(modes):
+        try:
+            return _run_single_mode(sizes, mode)
+        except Exception as e:  # noqa: BLE001 — inspect for OOM class
+            msg = str(e)
+            oom = (
+                "RESOURCE_EXHAUSTED" in msg
+                or "Out of memory" in msg
+                or "out of memory" in msg
+                or isinstance(e, MemoryError)
+            )
+            if oom and i + 1 < len(modes):
+                print(
+                    f"bench: phase A remat mode {mode!r} OOM; retrying "
+                    f"with {modes[i + 1]!r}",
+                    file=sys.stderr,
+                )
+                # drop the traceback: it pins the failed attempt's frame —
+                # and with it the params/opt buffers in HBM — which would
+                # make the fallback mode OOM too
+                last_err = e.with_traceback(None)
+                continue
+            raise
+    raise last_err  # pragma: no cover - loop always returns or raises
+
+
+def _run_single_mode(sizes: Dict[str, int], remat_mode: str) -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
     import optax
@@ -870,14 +929,14 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
     from torchft_tpu.optim import OptimizerWrapper
 
     steps = sizes["steps"]
-    model, config = _build_model(sizes)
+    model, config = _build_model(sizes, remat_mode=remat_mode)
     device = jax.devices()[0]
     flash = model._use_flash(sizes["seq"])
     print(
         f"bench: llama dim={config.dim} layers={config.n_layers} "
         f"seq={sizes['seq']} batch={sizes['batch']} "
         f"heads={config.n_heads}x{config.head_dim} "
-        f"params={model.num_params()/1e6:.1f}M remat={config.remat} "
+        f"params={model.num_params()/1e6:.1f}M remat={remat_mode} "
         f"flash={flash} on {device.platform} ({device.device_kind})",
         file=sys.stderr,
     )
@@ -986,17 +1045,18 @@ def run_single(sizes: Dict[str, int]) -> Dict[str, Any]:
         "platform": device.platform,
         "device_kind": device.device_kind,
         "tier": tier,
-        "remat": bool(config.remat),
+        "remat": remat_mode,
         "flash": bool(flash),
     }
     peak = _peak_tflops(device)
     if peak:
         out["peak_tflops"] = peak
         out["mfu"] = round(tflops / peak, 4)
-        if config.remat:
-            # full remat re-runs the forward in the backward: hardware does
-            # ~8N/token against the 6N the MFU convention counts
-            out["hw_mfu_est"] = round(tflops * (8.0 / 6.0) / peak, 4)
+        factor = _REMAT_HW_FACTOR.get(remat_mode, 1.0)
+        if factor > 1.0:
+            # remat re-runs part of the forward in the backward: hardware
+            # does ~factor*6N/token against the 6N the MFU convention counts
+            out["hw_mfu_est"] = round(tflops * factor / peak, 4)
     print(
         f"bench: {tflops:.2f} model TFLOP/s achieved (ft path), "
         f"mfu={out.get('mfu')}",

@@ -36,6 +36,48 @@ def _batch(config, batch=2, seq=32, seed=0):
     return tokens, targets
 
 
+class TestRematModes:
+    """Every remat policy must be a pure scheduling choice: identical loss
+    AND gradients, only memory/recompute differ (the reference gets this
+    from torch checkpointing via torchtitan)."""
+
+    @pytest.mark.parametrize("mode", ["attn", "ffn", "layer"])
+    def test_loss_and_grads_match_none(self, mode) -> None:
+        import dataclasses
+
+        base_cfg = llama_debug()
+        tokens, targets = _batch(base_cfg, batch=2, seq=32)
+        results = {}
+        for m in ("none", mode):
+            cfg = dataclasses.replace(base_cfg, remat_mode=m)
+            model = Llama(cfg)
+            params = model.init(jax.random.PRNGKey(0))
+            loss, grads = jax.jit(jax.value_and_grad(model.loss))(
+                params, (tokens, targets)
+            )
+            results[m] = (float(loss), grads)
+        assert results["none"][0] == pytest.approx(results[mode][0], rel=1e-6)
+        for (p, a), b in zip(
+            jax.tree_util.tree_flatten_with_path(results["none"][1])[0],
+            jax.tree_util.tree_leaves(results[mode][1]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
+                err_msg=f"{mode}: {p}",
+            )
+
+    def test_remat_bool_compat(self) -> None:
+        import dataclasses
+
+        cfg = dataclasses.replace(llama_debug(), remat=True)
+        assert cfg.effective_remat_mode == "layer"
+        assert llama_debug().effective_remat_mode == "none"
+        with pytest.raises(ValueError, match="unknown remat_mode"):
+            dataclasses.replace(
+                llama_debug(), remat_mode="bogus"
+            ).effective_remat_mode
+
+
 class TestLlamaModel:
     def test_forward_shapes(self) -> None:
         config = llama_debug()

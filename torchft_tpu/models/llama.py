@@ -22,6 +22,7 @@ Design choices for the TPU/XLA compilation model:
 
 from __future__ import annotations
 
+import functools
 import os
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
@@ -46,16 +47,35 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # sequence parallelism: ring attention over this mesh axis when set
     sp_axis: Optional[str] = None
-    # rematerialization: recompute each decoder layer in the backward pass,
-    # saving only the [B,S,dim] layer-boundary activations — trades ~1/3
-    # more FLOPs for O(layers) less activation HBM, which is what lets a
-    # ~1B-param config train on a single chip (the reference leans on
-    # torch's activation checkpointing via torchtitan for the same reason)
+    # rematerialization: recompute activations in the backward pass (the
+    # reference leans on torch's activation checkpointing via torchtitan
+    # for the same reason).  ``remat=True`` is per-layer ("layer" mode);
+    # ``remat_mode`` selects the policy explicitly:
+    #   - "none":  save everything (fastest; biggest activation HBM)
+    #   - "attn":  recompute only the attention half — attention is the
+    #     cheap-to-recompute minority of a layer's FLOPs (~10% extra
+    #     hardware work) while its qkv/out tensors are a meaningful bite
+    #     of saved bytes; the FFN's big gate/up intermediates stay saved.
+    #     The best MFU of the remat modes when it fits.
+    #   - "ffn":   recompute only the FFN half — frees the majority of
+    #     saved bytes (gate/up, 2×ffn_hidden wide) at ~26% extra hardware
+    #     FLOPs
+    #   - "layer": recompute whole layers, saving only the [B,S,dim]
+    #     layer-boundary residuals — O(layers) less activation HBM (~33%
+    #     extra FLOPs); what lets a ~1B-param config train on one chip
     remat: bool = False
+    remat_mode: Optional[str] = None  # None → "layer" if remat else "none"
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    @property
+    def effective_remat_mode(self) -> str:
+        mode = self.remat_mode or ("layer" if self.remat else "none")
+        if mode not in ("none", "attn", "ffn", "layer"):
+            raise ValueError(f"unknown remat_mode {mode!r}")
+        return mode
 
 
 def llama3_8b() -> LlamaConfig:
@@ -209,6 +229,15 @@ class Llama:
         the real Mosaic flash kernels for a pod without owning one)."""
         return os.environ.get("TORCHFT_FLASH_PLATFORM") or jax.default_backend()
 
+    @staticmethod
+    def _flash_blocks(seq: int) -> Tuple[int, int]:
+        """(block_q, block_k) for the flash kernel: env-tunable (the bench
+        sweeps them when hunting MFU), clamped to the sequence length."""
+        return (
+            min(seq, int(os.environ.get("TORCHFT_FLASH_BLOCK_Q", "512"))),
+            min(seq, int(os.environ.get("TORCHFT_FLASH_BLOCK_K", "512"))),
+        )
+
     def _use_flash(self, seq: int) -> bool:
         """Dispatch to the fused Pallas kernel (``ops/flash_attention.py``)
         when it applies: TPU backend (or forced), flash-friendly shapes, no
@@ -221,8 +250,12 @@ class Llama:
         if env == "0":
             return False
         # seq % 8: Mosaic requires 8-divisible sublane dims — a 130-long seq
-        # in [128, 512) would otherwise pick block_q=seq and fail to lower
-        if seq < 128 or seq % 8 or seq % min(512, seq):
+        # in [128, 512) would otherwise pick block_q=seq and fail to lower.
+        # the divisibility gate uses the RESOLVED block sizes, so an env
+        # override that doesn't divide seq falls back to the naive path
+        # instead of crashing the trace
+        block_q, block_k = self._flash_blocks(seq)
+        if seq < 128 or seq % 8 or seq % block_q or seq % block_k:
             return False
         if getattr(self, "_disable_flash", False):
             return False
@@ -267,6 +300,7 @@ class Llama:
             interpret = self._assumed_backend() != "tpu"
             mesh = self._flash_mesh()
             B, _, H, _ = q.shape
+            block_q, block_k = self._flash_blocks(q.shape[1])
             mesh_size = (
                 1 if mesh is None
                 else int(np.prod(list(mesh.shape.values())))
@@ -275,7 +309,8 @@ class Llama:
                 # bare kernel: single-device programs, or forced via env
                 # without a mesh (then operands replicate — caller's call)
                 return flash_attention(
-                    q, k, v, causal=True, interpret=interpret
+                    q, k, v, causal=True, interpret=interpret,
+                    block_q=block_q, block_k=block_k,
                 )
             bp = mesh.shape["dp"] * mesh.shape.get("fsdp", 1)
             if (
@@ -284,7 +319,8 @@ class Llama:
                 and cfg.n_kv_heads % mesh.shape["tp"] == 0
             ):
                 return flash_attention_sharded(
-                    q, k, v, mesh=mesh, causal=True, interpret=interpret
+                    q, k, v, mesh=mesh, causal=True, interpret=interpret,
+                    block_q=block_q, block_k=block_k,
                 )
             # mesh present but shapes don't shard evenly: naive path below
 
@@ -335,16 +371,32 @@ class Llama:
         attn = self._attention(q, k, v, positions)
         return x + attn.reshape(B, S, cfg.n_heads * hd) @ layer_params["wo"]
 
-    def _layer(
-        self, x: jax.Array, layer_params: Dict[str, jax.Array], rope, positions
+    def _ffn_block(
+        self, x: jax.Array, layer_params: Dict[str, jax.Array]
     ) -> jax.Array:
         cfg = self.config
-        x = self._attn_block(x, layer_params, rope, positions)
         h = self._rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h @ layer_params["w_gate"])
         up = h @ layer_params["w_up"]
-        x = x + (gate * up) @ layer_params["w_down"]
-        return x
+        return x + (gate * up) @ layer_params["w_down"]
+
+    def _layer(
+        self, x: jax.Array, layer_params: Dict[str, jax.Array], rope, positions
+    ) -> jax.Array:
+        mode = self.config.effective_remat_mode
+        attn = self._attn_block
+        ffn = self._ffn_block
+        ckpt = functools.partial(
+            jax.checkpoint,
+            policy=jax.checkpoint_policies.nothing_saveable,
+            prevent_cse=False,
+        )
+        if mode == "attn":
+            attn = ckpt(attn)
+        elif mode == "ffn":
+            ffn = ckpt(ffn)
+        x = attn(x, layer_params, rope, positions)
+        return ffn(x, layer_params)
 
     def apply(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
         """tokens [B, S] → logits [B, S, vocab] (fp32)."""
@@ -360,7 +412,7 @@ class Llama:
         def scan_body(carry, layer_params):
             return self._layer(carry, layer_params, rope, positions), None
 
-        if cfg.remat:
+        if cfg.effective_remat_mode == "layer":
             # keep only the residual stream at layer boundaries; each layer
             # recomputes in the backward pass
             # prevent_cse is unnecessary under lax.scan (per jax docs) and

@@ -58,7 +58,7 @@ class RehearsalReport:
     hbm_frac: float = 0.0
     lowered_grad: bool = False
     lowered_update: bool = False
-    remat: bool = False
+    remat: str = "none"
     error: Optional[str] = None
 
     def summary(self) -> str:
@@ -171,12 +171,24 @@ def _activation_estimate(
     b_loc = max(1, batch // bp)
     s_loc = max(1, seq // sp)
     bf16 = 2
-    boundaries = config.n_layers * b_loc * s_loc * config.dim * bf16
+    L = config.n_layers
+    boundaries = L * b_loc * s_loc * config.dim * bf16
     qkv = 4 * b_loc * s_loc * (config.n_heads // tp) * config.head_dim * bf16
     ffn = 3 * b_loc * s_loc * (config.ffn_hidden // tp) * bf16
     logits = b_loc * s_loc * (config.vocab_size // tp) * 4
-    live_layers = 2 if config.remat else config.n_layers
-    return float(boundaries + live_layers * (qkv + ffn) + logits)
+    # per remat policy (Llama.effective_remat_mode — the remat_mode knob,
+    # not just the legacy bool): which per-layer tensors stay live for the
+    # backward vs one recompute working set
+    mode = getattr(config, "effective_remat_mode", None) or (
+        "layer" if getattr(config, "remat", False) else "none"
+    )
+    live = {
+        "none": L * (qkv + ffn),
+        "layer": 2 * (qkv + ffn),
+        "attn": L * ffn + 2 * qkv,  # attention side recomputed
+        "ffn": L * qkv + 2 * ffn,  # FFN side recomputed
+    }[mode]
+    return float(boundaries + live + logits)
 
 
 def rehearse(
@@ -198,7 +210,7 @@ def rehearse(
         chip=chip,
         ok=False,
         hbm_bytes=CHIP_HBM_BYTES[chip],
-        remat=bool(getattr(model.config, "remat", False)),
+        remat=getattr(model.config, "effective_remat_mode", "none"),
     )
     cfg = model.config
     errors = report.divisibility_errors
