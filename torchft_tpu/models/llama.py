@@ -232,10 +232,20 @@ class Llama:
     @staticmethod
     def _flash_blocks(seq: int) -> Tuple[int, int]:
         """(block_q, block_k) for the flash kernel: env-tunable (the bench
-        sweeps them when hunting MFU), clamped to the sequence length."""
+        sweeps them when hunting MFU), clamped to the sequence length.
+        A malformed or non-positive override falls back to the 512 default
+        (the divisibility gate then decides flash vs naive)."""
+
+        def _env(name: str) -> int:
+            try:
+                v = int(os.environ.get(name, "512"))
+            except ValueError:
+                return 512
+            return v if v > 0 else 512
+
         return (
-            min(seq, int(os.environ.get("TORCHFT_FLASH_BLOCK_Q", "512"))),
-            min(seq, int(os.environ.get("TORCHFT_FLASH_BLOCK_K", "512"))),
+            min(seq, _env("TORCHFT_FLASH_BLOCK_Q")),
+            min(seq, _env("TORCHFT_FLASH_BLOCK_K")),
         )
 
     def _use_flash(self, seq: int) -> bool:
