@@ -1,0 +1,153 @@
+"""Scripted chaos scenario against a live FT fleet — the user-facing analog
+of the reference's Monarch orchestration example
+(``/root/reference/examples/monarch/train_distributed.py`` +
+``utils/failure.py``): supervise N replica groups as real processes, inject
+a typed failure mid-training, await the heal, and verify the fleet
+converged to identical parameters.
+
+    python examples/chaos_drill.py --replicas 3 --failure deadlock --steps 120
+
+Failure classes (``torchft_tpu.chaos.Failure``): ``kill`` (SIGKILL +
+supervisor restart + live heal), ``segfault`` (SIGSEGV, same recovery),
+``deadlock`` (SIGSTOP freeze of every thread — heartbeats included — until
+peers evict the frozen member via op timeouts; auto-thaw then rejoin).
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent
+sys.path.insert(0, str(REPO))
+
+from torchft_tpu.chaos import ChaosController, Failure, ProcessReplica  # noqa: E402
+from torchft_tpu.launcher import ReplicaSpec, ReplicaSupervisor  # noqa: E402
+from torchft_tpu.lighthouse import LighthouseServer  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("chaos_drill")
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument(
+        "--failure",
+        default="kill",
+        choices=["kill", "segfault", "deadlock"],
+    )
+    parser.add_argument("--victim", type=int, default=1)
+    parser.add_argument("--steps", type=int, default=120)
+    parser.add_argument("--freeze-secs", type=float, default=12.0)
+    parser.add_argument("--step-time", type=float, default=0.15)
+    args = parser.parse_args()
+    if not 0 <= args.victim < args.replicas:
+        parser.error(
+            f"--victim {args.victim} out of range for --replicas {args.replicas}"
+        )
+    if args.replicas < 2:
+        parser.error("need --replicas >= 2 (the victim heals from a peer)")
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=1,
+        join_timeout_ms=500,
+        quorum_tick_ms=20,
+    )
+    logdir = Path(tempfile.mkdtemp(prefix="chaos_drill_"))
+    cmd = [
+        sys.executable,
+        str(REPO / "examples" / "train_ddp.py"),
+        "--steps", str(args.steps),
+        "--platform", "cpu",
+        "--comm-timeout", "5",
+        "--step-time", str(args.step_time),
+    ]
+    logs = {i: logdir / f"rg{i}.log" for i in range(args.replicas)}
+    supervisor = ReplicaSupervisor(
+        [
+            ReplicaSpec(replica_group_id=i, cmd=list(cmd), log_path=str(logs[i]))
+            for i in range(args.replicas)
+        ],
+        f"127.0.0.1:{lighthouse.port}",
+        restart_delay_s=0.5,
+    )
+
+    def _progress(gid: int):
+        def read() -> int:
+            # COMMITTED steps only (the ReplicaHandle.progress contract —
+            # await_heal means "commits again", not "attempts again"), as
+            # a max over the whole log: a restarted incarnation starts
+            # logging from step 0 and must not read as regression
+            try:
+                text = logs[gid].read_text()
+            except OSError:
+                return 0
+            commits = [
+                int(n)
+                for n in re.findall(r"step (\d+) loss \S+ committed=True", text)
+            ]
+            commits += [int(n) for n in re.findall(r"FINAL step=(\d+)", text)]
+            return max(commits, default=0)
+
+        return read
+
+    controller = ChaosController(
+        [
+            ProcessReplica(f"rg{i}", supervisor, i, progress_fn=_progress(i))
+            for i in range(args.replicas)
+        ]
+    )
+    victim = controller.replicas[args.victim]
+
+    runner = threading.Thread(target=supervisor.run, daemon=True)
+    runner.start()
+    rc = 1
+    try:
+        if not controller.await_progress(victim, beyond=5, timeout_s=180.0):
+            print("fleet never got going", file=sys.stderr)
+            return 1
+        kw = (
+            {"secs": args.freeze_secs}
+            if args.failure == "deadlock"
+            else {}
+        )
+        controller.inject(Failure(args.failure), victim=victim, **kw)
+        print(f"injected {args.failure} into {victim.name}", flush=True)
+        if not controller.await_heal(victim, timeout_s=300.0):
+            print("victim never healed", file=sys.stderr)
+            return 1
+        print(f"{victim.name} healed; waiting for the fleet to finish")
+        deadline = time.monotonic() + 60.0 + args.steps * (args.step_time + 0.4)
+        runner.join(timeout=max(1.0, deadline - time.monotonic()))
+        if runner.is_alive():
+            print("fleet did not finish in time", file=sys.stderr)
+            return 1
+        # every replica must print the same final param hash
+        hashes = {}
+        for gid, path in logs.items():
+            m = re.findall(r"FINAL step=(\d+) params_sha=(\w+)", path.read_text())
+            if not m:
+                print(f"replica {gid} never finished", file=sys.stderr)
+                return 1
+            hashes[gid] = m[-1][1]
+        if len(set(hashes.values())) != 1:
+            print(f"replicas diverged: {hashes}", file=sys.stderr)
+            return 1
+        print(
+            f"DRILL PASSED: {args.replicas} replicas agree on "
+            f"params_sha={next(iter(hashes.values()))} after {args.failure} "
+            f"(events: {[(e.failure.value, e.victim) for e in controller.events]})"
+        )
+        rc = 0
+    finally:
+        supervisor.stop()
+        lighthouse.shutdown()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -253,13 +253,19 @@ def test_sigstop_process_wedge_evicts_and_heals(tmp_path) -> None:
     from torchft_tpu.chaos import ChaosController, Failure, ProcessReplica
 
     def _victim_step() -> int:
-        # committed step scraped from the victim's training log
-        # ("step N loss ..." per step, "FINAL step=N ..." at completion)
+        # COMMITTED steps only, as a max over the whole log (a restarted
+        # incarnation logs from step 0 again; failed attempts log
+        # committed=False and must not read as heal progress)
         try:
-            m = re.findall(r"step[= ](\d+)", logs[1].read_text())
-            return int(m[-1]) if m else 0
+            text = logs[1].read_text()
         except OSError:
             return 0
+        commits = [
+            int(n)
+            for n in re.findall(r"step (\d+) loss \S+ committed=True", text)
+        ]
+        commits += [int(n) for n in re.findall(r"FINAL step=(\d+)", text)]
+        return max(commits, default=0)
 
     victim = ProcessReplica(
         "rg1", supervisor, replica_group_id=1, progress_fn=_victim_step

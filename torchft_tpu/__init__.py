@@ -67,6 +67,10 @@ _LAZY = {
         "torchft_tpu.parallel.ring_attention",
         "ring_attention_sharded",
     ),
+    # chaos / scale validation
+    "ChaosController": ("torchft_tpu.chaos", "ChaosController"),
+    "Failure": ("torchft_tpu.chaos", "Failure"),
+    "rehearse": ("torchft_tpu.parallel.rehearsal", "rehearse"),
 }
 
 __all__ = list(_LAZY)
