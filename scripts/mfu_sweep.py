@@ -1,0 +1,141 @@
+"""Interactive single-chip MFU sweep: remat modes x flash blocks x batch.
+
+Runs bench.py phase A only (fleet/DiLoCo skipped) once per configuration in
+a fresh subprocess (so each trial gets a clean HBM), reads the streamed
+``bench_out.json``, and prints a ranked table.  Use when hunting the
+VERDICT r3 item-2 target (mfu >= 0.45) on real hardware:
+
+    python scripts/mfu_sweep.py                 # default grid
+    python scripts/mfu_sweep.py --trials remat=attn,block_q=1024 ...
+
+Each trial is one ``python bench.py`` invocation parameterized via env; a
+wedged-tunnel trial fails fast (probe window shortened) rather than
+stalling the sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "bench_out.json")
+
+
+def parse_trial(spec: str) -> dict:
+    out = {}
+    for part in spec.split(","):
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def default_grid():
+    for remat, block_q, batch in itertools.product(
+        ("attn", "ffn", "layer"), ("512", "1024"), ("8", "16")
+    ):
+        yield {"remat": remat, "block_q": block_q, "batch": batch}
+
+
+def run_trial(trial: dict, steps: int, timeout_s: float) -> dict:
+    # normalize the trial in place so reporting always has every key
+    trial.setdefault("remat", "attn")
+    trial.setdefault("block_q", "512")
+    trial.setdefault("block_k", "512")
+    trial.setdefault("batch", "8")
+    env = dict(os.environ)
+    env.update(
+        {
+            "TPUFT_BENCH_SKIP_FLEET": "1",
+            "TPUFT_BENCH_SKIP_DILOCO": "1",
+            "TPUFT_BENCH_STEPS": str(steps),
+            "TPUFT_BENCH_PROBE_WINDOW_S": "60",
+            "TPUFT_BENCH_REMAT_MODE": trial["remat"],
+            "TORCHFT_FLASH_BLOCK_Q": trial["block_q"],
+            "TORCHFT_FLASH_BLOCK_K": trial["block_k"],
+            "TPUFT_BENCH_BATCH": trial["batch"],
+        }
+    )
+    if os.path.exists(OUT):
+        os.remove(OUT)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env,
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return {**trial, "error": "timeout"}
+    try:
+        with open(OUT) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        data = {}
+    if data.get("cpu_fallback"):
+        return {**trial, "error": "cpu fallback (tunnel down)"}
+    single = data.get("single", {})
+    # the artifact streams incrementally, so it can exist and parse even
+    # when the bench crashed mid-phase — a nonzero rc or a missing phase-A
+    # section is a failed trial, never a quiet no-MFU row
+    if proc.returncode != 0 or not single:
+        tail = (proc.stderr or "")[-300:]
+        return {**trial, "error": f"rc={proc.returncode}: {tail}"}
+    return {
+        **trial,
+        "mfu": single.get("mfu"),
+        "mfu_ft": single.get("mfu_ft"),
+        "tflops": single.get("model_tflops_per_sec"),
+        "tok_s": single.get("faultfree_tokens_per_sec"),
+        "remat_used": single.get("remat"),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("mfu_sweep")
+    p.add_argument(
+        "--trials",
+        nargs="*",
+        default=None,
+        help="k=v,k=v specs (keys: remat, block_q, block_k, batch); "
+        "default: the remat x block_q x batch grid",
+    )
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--timeout", type=float, default=900.0)
+    args = p.parse_args()
+
+    trials = (
+        [parse_trial(s) for s in args.trials]
+        if args.trials
+        else list(default_grid())
+    )
+    results = []
+    for i, trial in enumerate(trials):
+        print(f"[{i + 1}/{len(trials)}] {trial} ...", flush=True)
+        res = run_trial(trial, args.steps, args.timeout)
+        print(f"    -> {res}", flush=True)
+        results.append(res)
+        if res.get("error", "").startswith("cpu fallback"):
+            print("tunnel down; aborting sweep", file=sys.stderr)
+            break
+
+    ok = [r for r in results if r.get("mfu") is not None]
+    ok.sort(key=lambda r: r["mfu"], reverse=True)
+    print("\n== ranked ==")
+    for r in ok:
+        print(
+            f"mfu={r['mfu']:.4f} (ft {r['mfu_ft']}) {r['tflops']} TFLOP/s "
+            f"remat={r['remat_used']} block_q={r['block_q']} "
+            f"batch={r['batch']} ({r['tok_s']} tok/s)"
+        )
+    if ok:
+        print(f"\nbest: {ok[0]}")
+
+
+if __name__ == "__main__":
+    main()
