@@ -26,11 +26,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "bench_out.json")
 
 
+TRIAL_KEYS = ("remat", "block_q", "block_k", "batch")
+
+
 def parse_trial(spec: str) -> dict:
     out = {}
     for part in spec.split(","):
         k, v = part.split("=", 1)
-        out[k.strip()] = v.strip()
+        k = k.strip()
+        if k not in TRIAL_KEYS:
+            raise SystemExit(
+                f"unknown trial key {k!r} (valid: {', '.join(TRIAL_KEYS)})"
+            )
+        out[k] = v.strip()
     return out
 
 
@@ -125,16 +133,32 @@ def main() -> None:
             break
 
     ok = [r for r in results if r.get("mfu") is not None]
+    # no MFU (unknown chip peak, TPUFT_PEAK_TFLOPS unset): rank by TFLOP/s
+    # rather than silently dropping completed trials
+    by_tflops = [
+        r
+        for r in results
+        if r.get("mfu") is None and r.get("tflops") is not None
+    ]
     ok.sort(key=lambda r: r["mfu"], reverse=True)
+    by_tflops.sort(key=lambda r: r["tflops"], reverse=True)
     print("\n== ranked ==")
-    for r in ok:
+    for r in ok + by_tflops:
+        mfu = f"mfu={r['mfu']:.4f}" if r.get("mfu") is not None else "mfu=?"
         print(
-            f"mfu={r['mfu']:.4f} (ft {r['mfu_ft']}) {r['tflops']} TFLOP/s "
+            f"{mfu} (ft {r['mfu_ft']}) {r['tflops']} TFLOP/s "
             f"remat={r['remat_used']} block_q={r['block_q']} "
-            f"batch={r['batch']} ({r['tok_s']} tok/s)"
+            f"block_k={r['block_k']} batch={r['batch']} "
+            f"({r['tok_s']} tok/s)"
         )
-    if ok:
-        print(f"\nbest: {ok[0]}")
+    if by_tflops and not ok:
+        print(
+            "(no MFU: chip peak unknown — set TPUFT_PEAK_TFLOPS; "
+            "ranked by TFLOP/s)",
+        )
+    best = (ok + by_tflops)[:1]
+    if best:
+        print(f"\nbest: {best[0]}")
 
 
 if __name__ == "__main__":

@@ -213,6 +213,26 @@ class TestFp8Wire:
         total = dequantize_rowwise(q_red, s_red, 512, np.float32)
         np.testing.assert_allclose(total, np.sum(originals, axis=0), atol=0.5)
 
+    def test_wire_kind_mismatch_detected(self) -> None:
+        """Both wire kinds are 1 byte/element with identical geometry, so a
+        TORCHFT_QUANT_KIND disagreement across replicas would reinterpret
+        peers' bytes silently — the packed header must catch it loudly."""
+        from torchft_tpu.collectives import _pack, _unpack
+        from torchft_tpu.communicator import CommunicatorError
+        from torchft_tpu.quantization import quantize_rowwise
+
+        q, s = quantize_rowwise(
+            np.ones(256, dtype=np.float32), row_size=128, kind="int8"
+        )
+        buf = _pack(q, s)
+        # correct kind round-trips
+        q2, s2 = _unpack(buf, q.shape[0], 128, "int8")
+        np.testing.assert_array_equal(q2, q)
+        np.testing.assert_allclose(s2, s)
+        # peer configured for the OTHER kind must error, not reinterpret
+        with pytest.raises(CommunicatorError, match="kind mismatch"):
+            _unpack(buf, q.shape[0], 128, "fp8")
+
 
 @pytest.mark.parametrize("kind", ["int8", "fp8"])
 def test_allreduce_quantized_fp8_wire(store, kind) -> None:

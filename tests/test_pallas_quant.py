@@ -229,6 +229,66 @@ class TestWireGolden:
 
 
 class TestDeviceQuantizedGradientPath:
+    @pytest.mark.parametrize("kind", ["int8", "fp8"])
+    def test_ft_allreduce_quant_kind_env(self, kind, monkeypatch) -> None:
+        """TORCHFT_QUANT_KIND selects the wire format of the
+        device-quantized gradient path: the payload handed to
+        ``Manager.allreduce_prequantized`` must carry the configured
+        dtype, and values must still round-trip."""
+        import ml_dtypes
+
+        monkeypatch.setenv("TORCHFT_QUANT_KIND", kind)
+        client = StubClient()
+        client.quorum_results.append(
+            _quorum_result(replica_world_size=2, max_world_size=2)
+        )
+        manager = Manager(
+            comm=DummyCommunicator(world_size=2),
+            load_state_dict=None,
+            state_dict=None,
+            min_replica_size=1,
+            checkpoint_transport=MemoryTransport(),
+            _manager_client=client,
+            rank=0,
+            world_size=1,
+        )
+        manager.start_quorum()
+        wire_dtypes = []
+        orig = manager.allreduce_prequantized
+
+        def spy(q, scales, n):
+            wire_dtypes.append(q.dtype)
+            return orig(q, scales, n)
+
+        monkeypatch.setattr(manager, "allreduce_prequantized", spy)
+        tree = {"w": jnp.full((64, 32), 3.0, dtype=jnp.float32)}
+        out = ft_allreduce(manager, tree, should_quantize=True)
+        expected_dtype = (
+            np.dtype(np.int8)
+            if kind == "int8"
+            else np.dtype(ml_dtypes.float8_e4m3fn)
+        )
+        assert wire_dtypes == [expected_dtype]
+        # passthrough double: sum == own contribution; AVG over 2 halves it
+        tol = 0.02 if kind == "int8" else 0.1  # e4m3: 3 mantissa bits
+        np.testing.assert_allclose(
+            np.asarray(out["w"]), np.full((64, 32), 1.5), atol=tol
+        )
+
+    def test_bad_quant_kind_fails_at_manager_startup(self, monkeypatch) -> None:
+        monkeypatch.setenv("TORCHFT_QUANT_KIND", "FP9")
+        with pytest.raises(ValueError, match="TORCHFT_QUANT_KIND"):
+            Manager(
+                comm=DummyCommunicator(world_size=1),
+                load_state_dict=None,
+                state_dict=None,
+                min_replica_size=1,
+                checkpoint_transport=MemoryTransport(),
+                _manager_client=StubClient(),
+                rank=0,
+                world_size=1,
+            )
+
     def test_ft_allreduce_device_quantized(self) -> None:
         client = StubClient()
         client.quorum_results.append(

@@ -35,7 +35,7 @@ from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
-from torchft_tpu.communicator import Communicator
+from torchft_tpu.communicator import Communicator, CommunicatorError
 from torchft_tpu.quantization import (
     DEFAULT_ROW_SIZE,
     FP8,
@@ -90,20 +90,45 @@ def _use_device_reduce(shard_bytes: int) -> bool:
         return False
 
 
+# one-byte wire-format tag leading every packed shard: both kinds are
+# 1 byte/element with identical geometry, so a TORCHFT_QUANT_KIND mismatch
+# across replicas would otherwise reinterpret peers' bytes silently —
+# garbage gradients instead of an error
+_KIND_TAG = {INT8: 0, FP8: 1}
+_TAG_KIND = {v: k for k, v in _KIND_TAG.items()}
+
+
+_HDR = 8  # 8-byte header (tag + reserved) keeps the f32 scales view aligned
+
+
 def _pack(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
-    """Payload + scales in one uint8 buffer so one collective carries both."""
+    """Header + payload + scales in one uint8 buffer so one collective
+    carries all three."""
+    header = np.zeros(_HDR, dtype=np.uint8)
+    header[0] = _KIND_TAG[_kind_of(q)]
     return np.concatenate(
-        [np.ascontiguousarray(q).reshape(-1).view(np.uint8), scales.view(np.uint8)]
+        [
+            header,
+            np.ascontiguousarray(q).reshape(-1).view(np.uint8),
+            scales.view(np.uint8),
+        ]
     )
 
 
 def _unpack(
     buf: np.ndarray, rows: int, row_size: int, kind: str
 ) -> Tuple[np.ndarray, np.ndarray]:
+    got = _TAG_KIND.get(int(buf[0]))
+    if got != kind:
+        raise CommunicatorError(
+            f"quantized-wire kind mismatch: peer sent {got!r}, this replica "
+            f"is configured for {kind!r} (check TORCHFT_QUANT_KIND agrees "
+            "across all replica groups)"
+        )
     payload = rows * row_size
     return (
-        buf[:payload].view(wire_dtype(kind)).reshape(rows, row_size),
-        buf[payload:].view(np.float32),
+        buf[_HDR : _HDR + payload].view(wire_dtype(kind)).reshape(rows, row_size),
+        buf[_HDR + payload :].view(np.float32),
     )
 
 

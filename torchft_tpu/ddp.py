@@ -285,12 +285,16 @@ def _allreduce_pytree_device_quantized(
     — this function only handles device-side quantization and pytree
     reassembly.  Returns a pending Work (the wire pipeline runs off-thread).
     """
-    from torchft_tpu.ops.pallas_quant import quantize_int8_rowwise_device
+    from torchft_tpu.ops.pallas_quant import quantize_rowwise_device
+    from torchft_tpu.quantization import quant_kind
 
     try:
         flat = _flatten_f32(leaves)
-        q, scales = quantize_int8_rowwise_device(flat)
-        # the only HBM→host bytes: int8 payload + f32 rowwise scales
+        # wire kind (int8 / fp8) from TORCHFT_QUANT_KIND; everything
+        # downstream — the pipelined ring, the reduce kernels, the
+        # dequantize — dispatches on the payload dtype
+        q, scales = quantize_rowwise_device(flat, kind=quant_kind())
+        # the only HBM→host bytes: 1-byte payload + f32 rowwise scales
         q_np, s_np = np.asarray(q), np.asarray(scales)
         work = manager.allreduce_prequantized(q_np, s_np, int(flat.shape[0]))
     except Exception as e:  # noqa: BLE001 — errors never reach the train loop

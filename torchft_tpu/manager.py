@@ -147,6 +147,11 @@ class Manager:
         self._quorum_timeout = _env_timeout(QUORUM_TIMEOUT_SEC_ENV, quorum_timeout)
         self._connect_timeout = _env_timeout(CONNECT_TIMEOUT_SEC_ENV, connect_timeout)
         quorum_retries = int(os.environ.get(QUORUM_RETRIES_ENV, quorum_retries))
+        # fail fast on a bad TORCHFT_QUANT_KIND: inside the step it would
+        # land in the error funnel and silently discard every step
+        from torchft_tpu.quantization import quant_kind
+
+        quant_kind()
 
         self._group_rank: int = rank if rank is not None else int(os.environ.get("RANK", 0))
         self._group_world_size: int = (
@@ -641,8 +646,11 @@ class Manager:
         try:
             if should_quantize:
                 from torchft_tpu.collectives import allreduce_quantized
+                from torchft_tpu.quantization import quant_kind
 
-                work = allreduce_quantized(self._comm, data)
+                # wire format for the quantized ring: int8 (default) or
+                # fp8 e4m3 (the reference's format) via TORCHFT_QUANT_KIND
+                work = allreduce_quantized(self._comm, data, kind=quant_kind())
             else:
                 work = self._comm.allreduce(data, ReduceOp.SUM, in_place=in_place)
 

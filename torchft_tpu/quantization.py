@@ -17,6 +17,7 @@ range within a row.
 
 from __future__ import annotations
 
+import os
 from typing import Tuple
 
 import numpy as np
@@ -35,6 +36,21 @@ except ImportError:  # pragma: no cover - ml_dtypes is a jax dependency
 
 INT8 = "int8"
 FP8 = "fp8"
+
+
+def quant_kind() -> str:
+    """The configured wire format for quantized collectives:
+    ``TORCHFT_QUANT_KIND`` = ``int8`` (default) or ``fp8`` (e4m3, the
+    reference's format).  Raises on anything else — callers that construct
+    long-lived objects (the Manager) validate at startup so a typo fails
+    fast instead of silently discarding every step through the error
+    funnel."""
+    kind = os.environ.get("TORCHFT_QUANT_KIND", INT8).strip().lower()
+    if kind not in (INT8, FP8):
+        raise ValueError(
+            f"TORCHFT_QUANT_KIND={kind!r}: must be {INT8!r} or {FP8!r}"
+        )
+    return kind
 
 
 def wire_dtype(kind: str) -> np.dtype:
