@@ -260,7 +260,7 @@ class ChaosController:
         mtbf_s: float,
         stop: threading.Event,
         on_inject: Optional[Callable[[ChaosEvent], None]] = None,
-        deadlock_secs: Callable[[], float] | None = None,
+        deadlock_secs: Optional[Callable[[], float]] = None,
     ) -> Dict[Failure, int]:
         """Inject failures on a Poisson schedule until ``stop`` — the soak
         loop (``scripts/soak.py``).  Returns per-class injection counts."""

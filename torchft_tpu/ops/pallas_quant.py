@@ -29,6 +29,7 @@ kernel can't lower.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Dict, Tuple
 
 import jax
@@ -91,7 +92,7 @@ def _on_tpu() -> bool:
 
 
 _KIND_OK: Dict[str, bool] = {}
-_KIND_OK_LOCK = __import__("threading").Lock()
+_KIND_OK_LOCK = threading.Lock()
 
 
 def _pallas_kind_ok(kind: str) -> bool:

@@ -85,6 +85,24 @@ def make_grad_step(
         )
 
 
+def match_param_by_suffix(
+    path: Tuple, shape: Tuple[int, ...], params_paths: Dict[Tuple, Tuple]
+) -> Any:
+    """Find the parameter entry whose key-path is a suffix of ``path`` with
+    a matching shape — optax embeds the params tree verbatim in every
+    params-mirroring opt-state subtree (momentum, Adam mu/nu, ...), so the
+    suffix+shape rule maps an opt-state leaf back to its parameter.
+    ``params_paths``: ``{path-tuple: (shape-tuple, value)}``; returns the
+    matched value or None.  Shared by :func:`sharded_opt_init` (value =
+    sharding) and ``parallel.rehearsal`` (value = PartitionSpec)."""
+    path = tuple(path)
+    for start in range(len(path)):
+        hit = params_paths.get(path[start:])
+        if hit is not None and hit[0] == tuple(shape):
+            return hit[1]
+    return None
+
+
 def sharded_opt_init(tx: Any, params: Any) -> Any:
     """Initialize optimizer state with correct shardings on multi-host.
 
@@ -98,27 +116,24 @@ def sharded_opt_init(tx: Any, params: Any) -> Any:
     replicates everything else (step counts etc.).
     """
     params_paths = {
-        tuple(path): leaf
+        tuple(path): (tuple(leaf.shape), leaf.sharding)
         for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+        if isinstance(leaf, jax.Array)
     }
     mesh = None
-    for leaf in params_paths.values():
-        if isinstance(leaf, jax.Array) and isinstance(leaf.sharding, NamedSharding):
-            mesh = leaf.sharding.mesh
+    for _shape, sharding in params_paths.values():
+        if isinstance(sharding, NamedSharding):
+            mesh = sharding.mesh
             break
 
     shapes = jax.eval_shape(tx.init, params)
 
     def _sharding_for(path: Tuple, shape_struct: Any) -> Any:
-        path = tuple(path)
-        for start in range(len(path)):
-            suffix = path[start:]
-            param = params_paths.get(suffix)
-            if (
-                isinstance(param, jax.Array)
-                and tuple(param.shape) == tuple(shape_struct.shape)
-            ):
-                return param.sharding
+        sharding = match_param_by_suffix(
+            path, shape_struct.shape, params_paths
+        )
+        if sharding is not None:
+            return sharding
         if mesh is not None:
             return NamedSharding(mesh, P())  # replicated (counts, scalars)
         return None

@@ -122,8 +122,10 @@ def _opt_specs(
     params_shapes: Any, param_specs: Any, tx: Any
 ) -> Tuple[Any, Any]:
     """(opt_state eval_shapes, opt_state PartitionSpecs).  Leaves mirroring
-    a parameter (matched by key-path suffix + shape, the
-    ``hsdp.sharded_opt_init`` rule) inherit its spec; the rest replicate."""
+    a parameter (matched by the shared ``hsdp.match_param_by_suffix`` rule)
+    inherit its spec; the rest replicate."""
+    from torchft_tpu.parallel.hsdp import match_param_by_suffix
+
     param_paths = {
         tuple(p): (tuple(l.shape), s)
         for (p, l), s in zip(
@@ -136,12 +138,8 @@ def _opt_specs(
     opt_shapes = jax.eval_shape(tx.init, params_shapes)
 
     def _spec_for(path, leaf):
-        path = tuple(path)
-        for start in range(len(path)):
-            hit = param_paths.get(path[start:])
-            if hit and hit[0] == tuple(leaf.shape):
-                return hit[1]
-        return P()
+        spec = match_param_by_suffix(path, leaf.shape, param_paths)
+        return spec if spec is not None else P()
 
     leaves, treedef = jax.tree_util.tree_flatten_with_path(opt_shapes)
     specs = jax.tree_util.tree_unflatten(
