@@ -199,6 +199,23 @@ def _sizes(on_cpu: bool) -> Dict[str, int]:
     }
 
 
+def _quant_kind_or_error() -> str:
+    """The validated wire kind actually in effect (the workers' Manager
+    would reject an invalid one at startup) — never the raw env string."""
+    from torchft_tpu.quantization import quant_kind
+
+    try:
+        return quant_kind()
+    except ValueError as e:
+        return f"invalid ({e})"
+
+
+def _diloco_quantized_sync() -> bool:
+    """One parse for the DiLoCo quantized-sync knob — the worker's behavior
+    and the artifact's metadata must read the same bit."""
+    return os.environ.get("TPUFT_BENCH_DILOCO_QUANT", "1") not in ("", "0")
+
+
 def _sync(tree: Any) -> None:
     """True device sync: fetch ONE scalar to host.  Under the axon tunnel
     ``jax.block_until_ready`` acknowledges dispatch without waiting for
@@ -411,6 +428,10 @@ def _worker_diloco(ev, manager, holder, grad_step, inner_tx, batches,
         sync_every=sync_every,
         num_fragments=fragments,
         fragment_sync_delay=delay,
+        # quantized pseudogradient sync (int8 default, fp8 via
+        # TORCHFT_QUANT_KIND) — the reference's DiLoCo ships fp8 outer
+        # syncs; 0 measures the f32 wire instead
+        should_quantize=_diloco_quantized_sync(),
     )
     inner = 0
     first = True
@@ -1295,6 +1316,8 @@ def _run_diloco_phase(
         "sync_every": sizes["diloco_sync_every"],
         "fragments": sizes["diloco_fragments"],
         "fragment_sync_delay": sizes["diloco_sync_delay"],
+        "quantized_sync": _diloco_quantized_sync(),
+        "quant_kind": _quant_kind_or_error(),
         "kills_in_sync_window": churn.get("kills", 0),
         "faultfree": faultfree,
         "churn": churn,
