@@ -147,7 +147,15 @@ def main() -> None:
         scale = jnp.float32(0.05 * (args.group + 1))
         grads = make_grads(holder["params"], scale)
         assert not grads["w"].is_fully_addressable, "test must exercise multi-host"
-        grads = ft_allreduce(manager, grads)
+        # MH_QUANTIZE exercises the sharded-leaf + quantized-wire combo:
+        # every group applies the identical requantized stream, so the
+        # cross-group equality assertions still hold bitwise
+        grads = ft_allreduce(
+            manager,
+            grads,
+            should_quantize=os.environ.get("MH_QUANTIZE", "")
+            not in ("", "0"),
+        )
         if manager.should_commit():
             holder["params"], holder["opt_state"] = update(
                 holder["params"], holder["opt_state"], grads

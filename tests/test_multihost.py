@@ -67,7 +67,12 @@ def _spawn_group(
     return procs
 
 
-def test_multihost_groups_kill_heal(tmp_path) -> None:
+@pytest.mark.parametrize("quantize", ["0", "1"])
+def test_multihost_groups_kill_heal(tmp_path, monkeypatch, quantize) -> None:
+    # both wires: the float ring AND the int8 ring over multi-host sharded
+    # leaves, each with kill/heal (replicas stay bitwise-equal under
+    # quantization — every group applies the same requantized stream)
+    monkeypatch.setenv("MH_QUANTIZE", quantize)
     lighthouse = LighthouseServer(
         bind="127.0.0.1:0",
         min_replicas=1,
