@@ -70,6 +70,12 @@ def main() -> None:
         default=int(os.environ.get("REPLICA_GROUP_ID", 0)),
     )
     parser.add_argument("--min-replicas", type=int, default=2)
+    parser.add_argument(
+        "--quantize",
+        action="store_true",
+        help="1-byte pseudogradient sync (int8 default, fp8 via "
+        "TORCHFT_QUANT_KIND) — the reference's DiLoCo wire",
+    )
     parser.add_argument("--platform", default=None)
     args = parser.parse_args()
     if args.platform:
@@ -103,6 +109,7 @@ def main() -> None:
         sync_every=args.sync_every,
         num_fragments=args.num_fragments,
         fragment_sync_delay=args.fragment_sync_delay,
+        should_quantize=args.quantize,
     )
 
     def loss_fn(p, batch):

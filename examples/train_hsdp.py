@@ -46,6 +46,12 @@ def main() -> None:
         default=int(os.environ.get("REPLICA_GROUP_ID", 0)),
     )
     parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument(
+        "--quantize-outer",
+        action="store_true",
+        help="1-byte wire for the replica-dim gradient ring (int8 "
+        "default, fp8 via TORCHFT_QUANT_KIND)",
+    )
     parser.add_argument("--platform", default=None)
     args = parser.parse_args()
     if args.platform:
@@ -71,7 +77,12 @@ def main() -> None:
         server_cls=manager_server_cls(tier),
     )
     trainer = HSDPTrainer(
-        model, optax.adamw(1e-3), mesh, manager, key=jax.random.PRNGKey(0)
+        model,
+        optax.adamw(1e-3),
+        mesh,
+        manager,
+        key=jax.random.PRNGKey(0),
+        quantize_outer=args.quantize_outer,
     )
     batch_sh = fsdp_shardings(model, mesh)[1]
 
