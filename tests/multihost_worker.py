@@ -36,6 +36,10 @@ def main() -> None:
     # a respawned peer's slow jax.distributed init can't miss the whole run
     p.add_argument("--wait-flag", default="")
     p.add_argument("--wait-at", type=int, default=4)
+    # second gate (e.g. park at step 0 until the whole fleet registered,
+    # AND at step 4 for the respawn rendezvous)
+    p.add_argument("--wait-flag2", default="")
+    p.add_argument("--wait-at2", type=int, default=-1)
     args = p.parse_args()
 
     import logging
@@ -141,6 +145,9 @@ def main() -> None:
             os._exit(9)  # whole-host kill: the harness respawns the group
         if args.wait_flag and manager.current_step() == args.wait_at:
             while not os.path.exists(args.wait_flag):
+                time.sleep(0.1)
+        if args.wait_flag2 and manager.current_step() == args.wait_at2:
+            while not os.path.exists(args.wait_flag2):
                 time.sleep(0.1)
         time.sleep(args.step_time)
         manager.start_quorum()
