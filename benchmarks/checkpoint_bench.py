@@ -21,6 +21,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+# this bench stages CPU jax arrays by design — pin the cpu platform at
+# import time, strictly BEFORE any backend init (post-init the update
+# silently no-ops and jax.local_devices would dial the axon TPU tunnel,
+# hanging the bench whenever the tunnel is wedged)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 def _state(total_bytes: int, chunk_mb: int = 64, leaf: str = "jax") -> dict:
     """Synthetic state dict.  ``leaf="jax"`` builds immutable jax CPU arrays

@@ -73,9 +73,16 @@ def _spawn_group(
     return procs
 
 
-def _await_groups_registered(lighthouse, names, deadline_s: float = 120.0):
+def _await_groups_registered(
+    lighthouse, names, procs, deadline_s: float = 120.0
+):
     deadline = time.monotonic() + deadline_s
     while time.monotonic() < deadline:
+        dead = [(p.args, p.poll()) for p in procs if p.poll() is not None]
+        if dead:
+            # a crashed worker can never register: fail NOW with its exit
+            # code instead of burning the deadline and blaming registration
+            pytest.fail(f"worker(s) died during startup: {dead}")
         beats = lighthouse._status().get("heartbeats", {})
         if set(names) <= {rid.split(":")[0] for rid in beats}:
             return
@@ -160,7 +167,9 @@ def test_multihost_quantized_wire(tmp_path, monkeypatch) -> None:
                 g, lighthouse.local_address(), store.port, results[g],
                 num_steps, wait_flag=str(flag), wait_at=0,
             )
-        _await_groups_registered(lighthouse, ["mh_group_0", "mh_group_1"])
+        _await_groups_registered(
+            lighthouse, ["mh_group_0", "mh_group_1"], all_procs
+        )
         flag.touch()
         deadline = time.monotonic() + 300
         for p in all_procs:
@@ -212,7 +221,9 @@ def test_multihost_groups_kill_heal(tmp_path, monkeypatch) -> None:
             die_at=2, wait_flag=str(start_flag), wait_at=0,
         )
         all_procs += group1
-        _await_groups_registered(lighthouse, ["mh_group_0", "mh_group_1"])
+        _await_groups_registered(
+            lighthouse, ["mh_group_0", "mh_group_1"], all_procs
+        )
         start_flag.touch()
 
         # group 1 dies whole (both hosts) at step 2.  Only the first rank to
