@@ -92,6 +92,51 @@ class TestHealBreakdown:
         assert bd["sane"] is True
 
 
+class TestPhaseARematWalk:
+    """The OOM-fallback walk over remat modes (attn -> ffn -> layer)."""
+
+    def test_falls_back_on_oom_and_stops_on_success(self, monkeypatch):
+        calls = []
+
+        def fake_mode(sizes, mode):
+            calls.append(mode)
+            if mode == "attn":
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            return {"remat": mode}
+
+        monkeypatch.setattr(bench, "_run_single_mode", fake_mode)
+        out = bench.run_single({"remat": 1})
+        assert calls == ["attn", "ffn"]
+        assert out == {"remat": "ffn"}
+
+    def test_non_oom_error_raises_immediately(self, monkeypatch):
+        def fake_mode(sizes, mode):
+            raise RuntimeError("Mosaic lowering failed: bad block shape")
+
+        monkeypatch.setattr(bench, "_run_single_mode", fake_mode)
+        import pytest
+
+        with pytest.raises(RuntimeError, match="Mosaic"):
+            bench.run_single({"remat": 1})
+
+    def test_oom_on_last_mode_raises(self, monkeypatch):
+        def fake_mode(sizes, mode):
+            raise RuntimeError("RESOURCE_EXHAUSTED")
+
+        monkeypatch.setattr(bench, "_run_single_mode", fake_mode)
+        import pytest
+
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            bench.run_single({"remat": 1})
+
+    def test_env_override_pins_single_mode(self, monkeypatch):
+        monkeypatch.setenv("TPUFT_BENCH_REMAT_MODE", "layer")
+        assert bench._phase_a_modes({"remat": 1}) == ["layer"]
+        monkeypatch.delenv("TPUFT_BENCH_REMAT_MODE")
+        assert bench._phase_a_modes({"remat": 0}) == ["none"]
+        assert bench._phase_a_modes({"remat": 1}) == ["attn", "ffn", "layer"]
+
+
 class TestFleetMetricsAggregation:
     def test_breakdown_mean_only_over_kills_with_phase(self):
         """A cold heal and a standby heal in one phase must not drag each
