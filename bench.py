@@ -48,7 +48,6 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import tempfile
 import threading
@@ -87,61 +86,19 @@ def _peak_tflops(device) -> Optional[float]:
     return None
 
 
-def _probe_backend(timeout_s: float = 180.0) -> bool:
-    """Check (in a subprocess, so a wedged TPU tunnel can't hang us) that
-    the default jax backend can actually initialize AND execute: the probe
-    round-trips one tiny computation to host, because under the axon
-    tunnel ``jax.devices()`` can succeed while execution wedges."""
-    try:
-        probe = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax, numpy; "
-                "x = jax.numpy.ones((8, 8)); "
-                "assert numpy.asarray(x @ x)[0, 0] == 8.0",
-            ],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
 def _probe_backend_with_retries() -> bool:
     """The TPU tunnel wedges *transiently*; a single failed probe must not
     silently downgrade the whole bench to CPU (round 3's artifact lost its
     TPU numbers to exactly that).  Retry within a bounded window, then fall
-    back LOUDLY."""
-    window_s = float(os.environ.get("TPUFT_BENCH_PROBE_WINDOW_S", "900"))
-    probe_timeout_s = float(
-        os.environ.get("TPUFT_BENCH_PROBE_TIMEOUT_S", "180")
+    back LOUDLY.  The probe itself lives in ``torchft_tpu.utils.probe``
+    (shared with ``__graft_entry__``)."""
+    from torchft_tpu.utils.probe import backend_executes_with_retries
+
+    return backend_executes_with_retries(
+        window_s=float(os.environ.get("TPUFT_BENCH_PROBE_WINDOW_S", "900")),
+        timeout_s=float(os.environ.get("TPUFT_BENCH_PROBE_TIMEOUT_S", "180")),
+        log=lambda msg: print(f"bench: {msg}", file=sys.stderr),
     )
-    deadline = time.time() + window_s
-    attempt = 0
-    while True:
-        attempt += 1
-        t0 = time.time()
-        if _probe_backend(probe_timeout_s):
-            if attempt > 1:
-                print(
-                    f"bench: backend probe succeeded on attempt {attempt}",
-                    file=sys.stderr,
-                )
-            return True
-        if time.time() >= deadline:
-            return False
-        wait = min(30.0, max(5.0, deadline - time.time()))
-        print(
-            f"bench: backend probe attempt {attempt} failed after "
-            f"{time.time() - t0:.0f}s; retrying in {wait:.0f}s "
-            f"({deadline - time.time():.0f}s left in retry window)",
-            file=sys.stderr,
-        )
-        if time.time() + wait >= deadline:
-            wait = max(0.0, deadline - time.time())
-        time.sleep(wait)
 
 
 def _configure_jax(platform: Optional[str]) -> None:

@@ -1,0 +1,78 @@
+"""Backend health probe, shared by bench.py and __graft_entry__.py.
+
+Under the axon debug tunnel ``jax.devices()`` can succeed while execution
+wedges, and a wedged backend hangs ANY in-process jax call forever — so
+the probe (a) runs in a subprocess with a timeout, and (b) round-trips one
+tiny computation to host rather than just enumerating devices.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Optional
+
+_PROBE_SRC = (
+    "import jax, numpy; "
+    "x = jax.numpy.ones((8, 8)); "
+    "assert numpy.asarray(x @ x)[0, 0] == 8.0"
+)
+
+_CACHE: dict = {}
+
+
+def backend_executes(
+    timeout_s: float = 180.0, use_cache: bool = True
+) -> bool:
+    """True when the default jax backend initializes AND executes.  The
+    result is memoized per process (it depends only on env/tunnel state,
+    and a wedged probe costs the full timeout every time)."""
+    if use_cache and "ok" in _CACHE:
+        return _CACHE["ok"]
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        ok = probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    _CACHE["ok"] = ok
+    return ok
+
+
+def backend_executes_with_retries(
+    window_s: float,
+    timeout_s: float = 180.0,
+    log=None,
+) -> bool:
+    """Retry :func:`backend_executes` within a bounded window — the tunnel
+    wedges transiently, and a single failed probe must not silently
+    downgrade a long measurement run to CPU."""
+    deadline = time.time() + window_s
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.time()
+        if backend_executes(timeout_s, use_cache=False):
+            _CACHE["ok"] = True
+            if attempt > 1 and log:
+                log(f"backend probe succeeded on attempt {attempt}")
+            return True
+        if time.time() >= deadline:
+            _CACHE["ok"] = False
+            return False
+        wait: Optional[float] = min(
+            30.0, max(5.0, deadline - time.time())
+        )
+        if log:
+            log(
+                f"backend probe attempt {attempt} failed after "
+                f"{time.time() - t0:.0f}s; retrying in {wait:.0f}s "
+                f"({deadline - time.time():.0f}s left in retry window)"
+            )
+        if time.time() + wait >= deadline:
+            wait = max(0.0, deadline - time.time())
+        time.sleep(wait)
