@@ -233,6 +233,33 @@ class TestFp8Wire:
         with pytest.raises(CommunicatorError, match="kind mismatch"):
             _unpack(buf, q.shape[0], 128, "fp8")
 
+    def test_wire_magic_mismatch_detected(self) -> None:
+        """A headerless legacy payload must fail LOUDLY: int8-quantized
+        gradients are mostly near zero, so a raw payload's first byte is
+        frequently 0 — without the magic it would pass a bare kind check
+        and parse 8 bytes shifted (silently corrupted gradients during a
+        mixed-version rolling restart)."""
+        from torchft_tpu.collectives import _pack, _unpack
+        from torchft_tpu.communicator import CommunicatorError
+        from torchft_tpu.quantization import quantize_rowwise
+
+        q, s = quantize_rowwise(
+            np.zeros(256, dtype=np.float32), row_size=128, kind="int8"
+        )
+        # a legacy (headerless) frame: raw payload + scales, first byte 0
+        legacy = np.concatenate(
+            [np.ascontiguousarray(q).reshape(-1).view(np.uint8), s.view(np.uint8)]
+        )
+        assert int(legacy[0]) == 0
+        with pytest.raises(CommunicatorError, match="magic mismatch"):
+            _unpack(legacy, q.shape[0], 128, "int8")
+        # corrupted/garbage header byte likewise
+        buf = _pack(q, s)
+        buf = buf.copy()
+        buf[0] = 0x00
+        with pytest.raises(CommunicatorError, match="magic mismatch"):
+            _unpack(buf, q.shape[0], 128, "int8")
+
 
 @pytest.mark.parametrize("kind", ["int8", "fp8"])
 def test_allreduce_quantized_fp8_wire(store, kind) -> None:

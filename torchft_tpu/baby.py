@@ -239,15 +239,28 @@ class _ArenaPool:
         self._free: Dict[int, List[shared_memory.SharedMemory]] = {}
         self._lock = threading.Lock()
         self._live: Dict[str, shared_memory.SharedMemory] = {}
+        self._destroyed = False
+
+    @property
+    def destroyed(self) -> bool:
+        return self._destroyed
 
     def acquire(self, nbytes: int) -> shared_memory.SharedMemory:
         size = 1 << max(12, (nbytes - 1).bit_length())
         with self._lock:
+            if self._destroyed:
+                # a straggler op racing past shutdown() would otherwise
+                # create a fresh segment nothing ever unlinks
+                raise CommunicatorAborted("shutdown")
             bucket = self._free.get(size)
             if bucket:
                 return bucket.pop()
         shm = shared_memory.SharedMemory(create=True, size=size)
         with self._lock:
+            if self._destroyed:
+                shm.close()
+                shm.unlink()
+                raise CommunicatorAborted("shutdown")
             self._live[shm.name] = shm
         return shm
 
@@ -259,14 +272,22 @@ class _ArenaPool:
 
     def destroy(self) -> None:
         with self._lock:
+            self._destroyed = True
             live = list(self._live.values())
             self._live.clear()
             self._free.clear()
         for shm in live:
+            # unlink FIRST: it always succeeds and frees the name even while
+            # a landing callback still holds a numpy view over shm.buf —
+            # close() would raise BufferError ('cannot close exported
+            # pointers exist') in exactly that shutdown race
             try:
-                shm.close()
                 shm.unlink()
             except OSError:  # pragma: no cover - already gone
+                pass
+            try:
+                shm.close()
+            except (OSError, BufferError):
                 pass
 
 
@@ -395,6 +416,11 @@ class BabyCommunicator(Communicator):
         self.abort("superseded by reconfigure")
         with self._lock:
             self._errored = None
+            if self._arenas.destroyed:
+                # a shutdown()-then-configure() revival must not inherit the
+                # destroyed flag: _guard_landing would misreport every later
+                # genuine landing error as CommunicatorAborted
+                self._arenas = _ArenaPool()
         self._spawn()
         self._rank = rank
         self._world_size = world_size
@@ -427,12 +453,17 @@ class BabyCommunicator(Communicator):
         the segment; results land back into the caller's buffers (in_place)
         or fresh copies."""
         metas, total = _pack_metas(arrays)
-        shm = self._arenas.acquire(total)
-        for a, view in zip(arrays, _views(shm.buf, metas)):
-            np.copyto(view, a)
+        pool = self._arenas
+        try:
+            shm = pool.acquire(total)
+            for a, view in zip(arrays, _views(shm.buf, metas)):
+                np.copyto(view, a)
+        except (ValueError, TypeError, OSError) as exc:
+            self._raise_if_destroyed(pool, exc)
+            raise
         work = self._submit(op, dict(shm=shm.name, metas=metas, **extra))
 
-        release_once = self._release_once(shm)
+        release_once = self._release_once(pool, shm)
 
         def _land(result: object):
             if isinstance(result, dict) and "meta" in result:
@@ -454,18 +485,51 @@ class BabyCommunicator(Communicator):
             release_once()
             return out_list[0] if single else out_list
 
-        landed = work.then(_land)
+        landed = work.then(self._guard_landing(pool, _land))
         # failure path (and belt-and-braces): never leak the arena
         landed.future().add_done_callback(lambda _f: release_once())
         return landed
 
-    def _release_once(self, shm) -> Callable[[], None]:
+    def _guard_landing(self, pool: _ArenaPool, fn: Callable) -> Callable:
+        """Wrap a shm-landing callback: a result racing ``shutdown()`` can
+        find the arena pool already destroyed, and ``_views`` on a
+        closed/unlinked mapping raises an opaque ValueError — surface the
+        abort the shutdown intended instead.
+
+        The caller passes the pool its op actually acquired from: a
+        concurrent shutdown-then-configure swaps ``self._arenas`` for a
+        fresh pool, and re-reading the live attribute here would see
+        ``destroyed=False`` and leak the raw ValueError."""
+
+        def _wrapped(result):
+            try:
+                return fn(result)
+            except (ValueError, TypeError, OSError) as exc:
+                self._raise_if_destroyed(pool, exc)
+                raise
+
+        return _wrapped
+
+    def _raise_if_destroyed(self, pool: _ArenaPool, exc: BaseException) -> None:
+        """Map an shm-access error racing ``shutdown()`` to the abort it
+        really is.  ValueError: released memoryview (mid-destroy window);
+        TypeError: ``shm.buf`` is None after ``close()`` completed;
+        OSError: unlinked mapping."""
+        if pool.destroyed:
+            reason = str(self._errored) if self._errored else "shutdown"
+            raise CommunicatorAborted(reason) from exc
+
+    def _release_once(self, pool: _ArenaPool, shm) -> Callable[[], None]:
+        """Release against the pool the op ACQUIRED from (same invariant as
+        :meth:`_guard_landing`): after a shutdown-then-configure pool swap,
+        releasing a stale segment into the fresh pool could recycle an
+        unlinked mapping under a name the kernel has since reused."""
         released = threading.Event()
 
         def _release() -> None:
             if not released.is_set():
                 released.set()
-                self._arenas.release(shm)
+                pool.release(shm)
 
         return _release
 
@@ -533,15 +597,20 @@ class BabyCommunicator(Communicator):
                 view = bytes(data)  # non-contiguous buffer-likes
         n = len(view)
         if n >= _SHM_MIN:
-            shm = self._arenas.acquire(n)
-            np.frombuffer(shm.buf, np.uint8, count=n)[:] = np.frombuffer(
-                view, dtype=np.uint8
-            )
+            pool = self._arenas
+            try:
+                shm = pool.acquire(n)
+                np.frombuffer(shm.buf, np.uint8, count=n)[:] = np.frombuffer(
+                    view, dtype=np.uint8
+                )
+            except (ValueError, TypeError, OSError) as exc:
+                self._raise_if_destroyed(pool, exc)
+                raise
             work = self._submit(
                 "send_bytes_shm", dict(shm=shm.name, n=n, dst=dst, tag=tag)
             )
             work.future().add_done_callback(
-                lambda _f: self._arenas.release(shm)
+                lambda _f: pool.release(shm)
             )
             return work
         if not isinstance(view, bytes):
@@ -556,8 +625,9 @@ class BabyCommunicator(Communicator):
             # the child receives straight into the shared segment; the
             # parent pays one copy into the caller's buffer (the pickle
             # path pays serialize + deserialize + copy)
-            shm = self._arenas.acquire(out.nbytes)
-            release_once = self._release_once(shm)
+            pool = self._arenas
+            shm = pool.acquire(out.nbytes)
+            release_once = self._release_once(pool, shm)
             work = self._submit(
                 "recv_bytes_shm",
                 dict(shm=shm.name, cap=out.nbytes, src=src, tag=tag),
@@ -571,7 +641,7 @@ class BabyCommunicator(Communicator):
                 release_once()
                 return n
 
-            landed = work.then(_land_shm)
+            landed = work.then(self._guard_landing(pool, _land_shm))
             landed.future().add_done_callback(lambda _f: release_once())
             return landed
         work = self._submit("recv_bytes", dict(src=src, tag=tag))

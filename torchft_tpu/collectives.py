@@ -90,22 +90,27 @@ def _use_device_reduce(shard_bytes: int) -> bool:
         return False
 
 
-# one-byte wire-format tag leading every packed shard: both kinds are
+# two-byte wire-format header leading every packed shard: both kinds are
 # 1 byte/element with identical geometry, so a TORCHFT_QUANT_KIND mismatch
 # across replicas would otherwise reinterpret peers' bytes silently —
-# garbage gradients instead of an error
-_KIND_TAG = {INT8: 0, FP8: 1}
+# garbage gradients instead of an error.  header[0] is a nonzero magic so a
+# headerless legacy payload (int8-quantized gradients are mostly near zero,
+# making a leading 0 byte common) fails LOUDLY instead of parsing 8 bytes
+# shifted; header[1] is the kind tag.
+_WIRE_MAGIC = 0xA7
+_KIND_TAG = {INT8: 1, FP8: 2}
 _TAG_KIND = {v: k for k, v in _KIND_TAG.items()}
 
 
-_HDR = 8  # 8-byte header (tag + reserved) keeps the f32 scales view aligned
+_HDR = 8  # 8-byte header (magic + kind + reserved) keeps the f32 scales view aligned
 
 
 def _pack(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
     """Header + payload + scales in one uint8 buffer so one collective
     carries all three."""
     header = np.zeros(_HDR, dtype=np.uint8)
-    header[0] = _KIND_TAG[_kind_of(q)]
+    header[0] = _WIRE_MAGIC
+    header[1] = _KIND_TAG[_kind_of(q)]
     return np.concatenate(
         [
             header,
@@ -118,7 +123,13 @@ def _pack(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
 def _unpack(
     buf: np.ndarray, rows: int, row_size: int, kind: str
 ) -> Tuple[np.ndarray, np.ndarray]:
-    got = _TAG_KIND.get(int(buf[0]))
+    if int(buf[0]) != _WIRE_MAGIC:
+        raise CommunicatorError(
+            "quantized-wire header magic mismatch: peer payload does not "
+            "start with the framed header (mixed-version replica group? "
+            "all groups must run the same torchft_tpu wire build)"
+        )
+    got = _TAG_KIND.get(int(buf[1]))
     if got != kind:
         raise CommunicatorError(
             f"quantized-wire kind mismatch: peer sent {got!r}, this replica "

@@ -30,9 +30,41 @@ import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger("torchft_tpu.launcher")
+
+
+def _reap_async(proc: subprocess.Popen, what: str) -> Optional[threading.Thread]:
+    """Wait → SIGKILL → wait, off-thread.  The caller delivers SIGTERM
+    inline FIRST — off-thread delivery could be skipped entirely if the
+    supervisor exits before the daemon thread runs.
+
+    Retirement runs on the supervisor's poll loop; blocking it for a wedged
+    child (SIGTERM ignored in native code) would stall crash detection for
+    every OTHER group, so escalation happens on a daemon reaper thread.
+    ``Popen.wait`` is safe to call concurrently (internal waitpid lock).
+    Returns the reaper thread so terminal paths (``stop()``/``run()``) can
+    join it — daemon threads die with the interpreter, which would skip the
+    SIGKILL."""
+    if proc.poll() is not None:
+        return None
+
+    def _reap() -> None:
+        try:
+            proc.wait(timeout=5.0)
+            return
+        except subprocess.TimeoutExpired:
+            pass
+        proc.kill()
+        try:
+            proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            logger.warning("%s did not die after SIGKILL", what)
+
+    t = threading.Thread(target=_reap, name=f"reap-{what}", daemon=True)
+    t.start()
+    return t
 
 
 @dataclass
@@ -89,6 +121,7 @@ class ReplicaSupervisor:
         self._gate_seq = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._reapers: List[threading.Thread] = []
 
     def _spawn(
         self, spec: ReplicaSpec, standby_gate: Optional[str] = None
@@ -142,7 +175,12 @@ class ReplicaSupervisor:
         """Run until every group exits cleanly (rc 0) or is out of restarts.
         Returns the worst exit code."""
         with self._lock:
+            # _stop re-checked under the lock (same race class as the
+            # respawn/re-warm paths): a stop() that ran before this spawn
+            # loop snapshotted an empty fleet and will terminate nothing
             for spec in self._specs:
+                if self._stop.is_set():
+                    break
                 self._procs[spec.replica_group_id] = self._spawn(spec)
                 self._restarts[spec.replica_group_id] = 0
                 if spec.standby:
@@ -152,6 +190,17 @@ class ReplicaSupervisor:
 
         worst_rc = 0
         alive = {spec.replica_group_id for spec in self._specs}
+        try:
+            worst_rc = self._supervise(alive)
+        finally:
+            # always — an exception escaping the supervise loop must not
+            # abandon retire-path reapers mid-escalation (daemon threads
+            # die with the interpreter, skipping SIGTERM/SIGKILL)
+            self._drain_reapers()
+        return worst_rc
+
+    def _supervise(self, alive: set) -> int:
+        worst_rc = 0
         while alive and not self._stop.is_set():
             time.sleep(0.2)
             for spec in self._specs:
@@ -163,7 +212,15 @@ class ReplicaSupervisor:
                 if spec.standby:
                     with self._lock:
                         sb = self._standbys.get(gid)
-                        if sb is not None and sb[0].poll() is not None:
+                        # re-check under the lock: a re-warm racing stop()
+                        # would land a fresh spare AFTER stop() cleared
+                        # _standbys — never terminated, outliving the
+                        # supervisor
+                        if (
+                            sb is not None
+                            and sb[0].poll() is not None
+                            and not self._stop.is_set()
+                        ):
                             logger.warning(
                                 "standby for group %d died while parked; "
                                 "re-warming",
@@ -230,18 +287,45 @@ class ReplicaSupervisor:
                 if self._stop.is_set():
                     break
                 with self._lock:
-                    # under the lock so stop()/kill() can never miss a
-                    # freshly respawned child
+                    # under the lock, re-checking _stop: stop() sets the
+                    # flag before snapshotting under this same lock, so a
+                    # respawn racing it would land a child stop() never
+                    # terminates
+                    if self._stop.is_set():
+                        break
                     self._procs[gid] = self._spawn(spec)
         return worst_rc
+
+    # bounded by _reap_async's 5 s SIGTERM + 5 s SIGKILL waits, plus margin
+    _REAP_DEADLINE_S = 12.0
+
+    def _drain_reapers(self, extra: Sequence[threading.Thread] = ()) -> None:
+        """Join all outstanding reaper threads (terminal paths only):
+        daemon reapers die with the interpreter, which would skip the
+        SIGKILL escalation for a child wedged in native code."""
+        with self._lock:
+            reapers, self._reapers = self._reapers + list(extra), []
+        deadline = time.monotonic() + self._REAP_DEADLINE_S
+        for t in reapers:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     def _retire_standby(self, replica_group_id: int) -> None:
         """A group that left the fleet (clean exit or out of restarts) must
         not leak its parked spare — the spare holds TPU/compile resources."""
         with self._lock:
             sb = self._standbys.pop(replica_group_id, None)
-        if sb is not None and sb[0].poll() is None:
-            sb[0].terminate()
+        if sb is not None:
+            # SIGTERM inline (the reaper thread only escalates): if the
+            # supervisor exits before the daemon reaper runs, the spare must
+            # at least have been told to die
+            if sb[0].poll() is None:
+                sb[0].terminate()
+            t = _reap_async(sb[0], f"standby for group {replica_group_id}")
+            if t is not None:
+                # terminal paths (stop / run-exit) join these: a daemon
+                # reaper dying with the interpreter would skip the SIGKILL
+                with self._lock:
+                    self._reapers.append(t)
 
     def kill(self, replica_group_id: int, sig: int = signal.SIGKILL) -> bool:
         """Chaos hook: kill one group's process (it will be restarted)."""
@@ -255,13 +339,24 @@ class ReplicaSupervisor:
     def stop(self) -> None:
         self._stop.set()
         with self._lock:
-            for proc in self._procs.values():
-                if proc.poll() is None:
-                    proc.terminate()
-            for proc, _gate in self._standbys.values():
-                if proc.poll() is None:
-                    proc.terminate()
+            procs = list(self._procs.values())
+            standbys = [p for p, _gate in self._standbys.values()]
             self._standbys.clear()
+        # SIGTERM is delivered inline — stop() may be the supervisor's last
+        # act, and a daemon reaper thread is not guaranteed to run before
+        # interpreter exit.  The wait/SIGKILL escalation runs on reaper
+        # threads (concurrently across children) but stop() JOINS them with
+        # a bounded deadline: primaries and spares alike must not outlive
+        # the supervisor holding TPU resources, even when wedged in native
+        # code ignoring SIGTERM.
+        reapers = []
+        for proc in procs + standbys:
+            if proc.poll() is None:
+                proc.terminate()
+                t = _reap_async(proc, "child (supervisor stop)")
+                if t is not None:
+                    reapers.append(t)
+        self._drain_reapers(extra=reapers)
 
 
 def main(argv: Optional[List[str]] = None) -> None:

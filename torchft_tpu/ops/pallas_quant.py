@@ -97,12 +97,14 @@ _KIND_OK_LOCK = threading.Lock()
 
 def _pallas_kind_ok(kind: str) -> bool:
     """One-shot probe: can this chip's Mosaic lower the wire dtype?  int8 is
-    universal; fp8 conversion support varies by TPU generation.  Probes
-    BOTH kernels gated on it — the quantize store and the structurally
-    different reduce ([w, rows, R] fp8 loads + multiply) — because either
-    can fail independently.  The verdict is published only AFTER both
-    probes finish (under a lock): concurrent collectives must never see a
-    provisional True and take an un-lowerable Pallas branch."""
+    universal; fp8 conversion support varies by TPU generation.  Probes ALL
+    THREE kernels gated on it — the quantize store, the structurally
+    different reduce ([w, rows, R] fp8 loads + multiply), and the dequant
+    load-with-multiply — because each can fail independently and
+    :func:`dequantize_rowwise_device` dispatches on this same verdict.  The
+    verdict is published only AFTER every probe finishes (under a lock):
+    concurrent collectives must never see a provisional True and take an
+    un-lowerable Pallas branch."""
     if kind == INT8:
         return True
     with _KIND_OK_LOCK:
@@ -123,6 +125,11 @@ def _pallas_kind_ok(kind: str) -> bool:
             jax.jit(
                 functools.partial(_pallas_reduce, kind=kind, interpret=False)
             ).lower(qs, sc).compile()
+            q1 = jnp.zeros((BLOCK_ROWS, ROW_SIZE), _wire_jnp_dtype(kind))
+            s1 = jnp.ones((BLOCK_ROWS, 1), jnp.float32)
+            jax.jit(
+                functools.partial(_pallas_dequant, interpret=False)
+            ).lower(q1, s1).compile()
             _KIND_OK[kind] = True
         except Exception:  # noqa: BLE001 — any lowering failure → jnp fallback
             _KIND_OK[kind] = False
@@ -251,23 +258,15 @@ def reduce_quantized_device(
     return _pallas_reduce(qs, scales, kind, interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "interpret"))
-def dequantize_rowwise_device(
-    q: jax.Array, scales: jax.Array, n: int, interpret: bool = False
+def _pallas_dequant(
+    q: jax.Array, scales: jax.Array, interpret: bool
 ) -> jax.Array:
-    """(wire [rows, row_size], f32 [rows, 1]) → float32 [n].  The wire kind
-    is carried by ``q.dtype``."""
-    rows, row_size = q.shape
-    kind = INT8 if q.dtype == jnp.int8 else FP8
-    if not (interpret or (_on_tpu() and _pallas_kind_ok(kind))):
-        out = q.astype(jnp.float32) * scales
-        return out.reshape(-1)[:n]
-
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    rows, row_size = q.shape
     grid = (rows // BLOCK_ROWS,)
-    out = pl.pallas_call(
+    return pl.pallas_call(
         _dequant_kernel,
         grid=grid,
         in_specs=[
@@ -282,6 +281,19 @@ def dequantize_rowwise_device(
         out_shape=jax.ShapeDtypeStruct((rows, row_size), jnp.float32),
         interpret=interpret,
     )(q, scales)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def dequantize_rowwise_device(
+    q: jax.Array, scales: jax.Array, n: int, interpret: bool = False
+) -> jax.Array:
+    """(wire [rows, row_size], f32 [rows, 1]) → float32 [n].  The wire kind
+    is carried by ``q.dtype``."""
+    kind = INT8 if q.dtype == jnp.int8 else FP8
+    if not (interpret or (_on_tpu() and _pallas_kind_ok(kind))):
+        out = q.astype(jnp.float32) * scales
+        return out.reshape(-1)[:n]
+    out = _pallas_dequant(q, scales, interpret)
     return out.reshape(-1)[:n]
 
 

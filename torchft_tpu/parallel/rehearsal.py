@@ -5,7 +5,7 @@ The reference claims Llama-3 8B/70B fault-tolerant HSDP at cluster scale
 short of burning the cluster.  On TPU the XLA compilation model lets us do
 better: ``jax.jit(...).trace(...).lower(lowering_platforms=("tpu",))`` over
 a :class:`jax.sharding.AbstractMesh` traces and SPMD-partitions the REAL
-train step for the REAL pod shape on any host, with zero devices —所 the
+train step for the REAL pod shape on any host, with zero devices — the
 full v5p-256 70B program is validated (tracing, sharding propagation,
 divisibility, collective layout) in seconds on a CPU box.
 
