@@ -1,0 +1,173 @@
+"""Emulated-DCN data-plane validation (round-4 verdict item 6).
+
+Loopback hides the regime the replica dimension is actually designed for:
+cross-datacenter / cross-pod links at ~1-10 Gb/s and 2-10 ms RTT (the
+DiLoCo deployment story, ``/root/reference/torchft/local_sgd.py:569-634``).
+This harness re-runs the three data-plane patterns that matter for fault
+tolerance under the TCP tier's netem-style sender pacer
+(``communicator._NetEmu``, env ``TORCHFT_NET_GBPS``/``TORCHFT_NET_RTT_MS``):
+
+- ``f32 ring``:   plain SUM-allreduce of a gradient-sized payload
+- ``quant ring``: the int8 windowed pipelined allreduce (4x less wire)
+- ``heal``:       a CommTransport checkpoint send/recv (victim rejoin path)
+
+at a set of profiles including unshaped loopback as the control.  The
+quantized ring must BEAT the f32 ring at the constrained profiles — that is
+the claim that justifies its existence — while on unshaped loopback it may
+lose (host quantize cycles the fat link never repays; exactly why the
+DiLoCo quant gate is measurement-driven, ``bench.py``).
+
+Usage: python benchmarks/dcn_bench.py [--mb 30] [--iters 3] [--md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (name, link Gbit/s, RTT ms); 0/0 = unshaped loopback control
+PROFILES = [
+    ("loopback", 0.0, 0.0),
+    ("dcn_10g_2ms", 10.0, 2.0),
+    ("wan_1g_10ms", 1.0, 10.0),
+]
+
+
+def _rank_main(rank, world, port, mb, iters, gbps, rtt_ms, out_q):
+    os.environ["TORCHFT_NET_GBPS"] = str(gbps)
+    os.environ["TORCHFT_NET_RTT_MS"] = str(rtt_ms)
+    os.environ.setdefault("TORCHFT_QUANT_DEVICE_REDUCE", "0")
+    from torchft_tpu.checkpointing.comm_transport import CommTransport
+    from torchft_tpu.collectives import allreduce_quantized
+    from torchft_tpu.communicator import TCPCommunicator
+
+    comm = TCPCommunicator(timeout_s=300.0)
+    comm.configure(
+        f"127.0.0.1:{port}/dcn_{gbps}_{rtt_ms}",
+        replica_id=f"r{rank}",
+        rank=rank,
+        world_size=world,
+    )
+    n = mb * (1 << 20) // 4
+    rng = np.random.default_rng(rank)
+    buf = rng.normal(size=n).astype(np.float32)
+    results = {}
+
+    # f32 ring
+    comm.allreduce(buf.copy()).wait(timeout=300.0)  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        comm.allreduce(buf.copy()).wait(timeout=300.0)
+    results["f32_ring_s"] = (time.perf_counter() - t0) / iters
+
+    # quantized ring
+    allreduce_quantized(comm, buf.copy()).wait(timeout=300.0)  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        allreduce_quantized(comm, buf.copy()).wait(timeout=300.0)
+    results["quant_ring_s"] = (time.perf_counter() - t0) / iters
+
+    # heal transfer: rank 0 = survivor sending live weights, rank 1 = victim
+    transport = CommTransport(comm, timeout=300.0)
+    state = {"params": buf.copy(), "opt": rng.normal(size=n // 2).astype(np.float32)}
+    heal_bytes = sum(a.nbytes for a in state.values())
+    t0 = time.perf_counter()
+    for i in range(max(1, iters // 2)):
+        if rank == 0:
+            transport.send_checkpoint([1], step=i, state_dict=state, timeout=300.0)
+        else:
+            got = transport.recv_checkpoint(0, "", step=i, timeout=300.0)
+            assert got["params"].nbytes == state["params"].nbytes
+    results["heal_s"] = (time.perf_counter() - t0) / max(1, iters // 2)
+    results["heal_gbps"] = heal_bytes / results["heal_s"] / 1e9
+
+    comm.barrier().wait(timeout=60.0)
+    comm.shutdown()
+    if rank == 0:
+        out_q.put(results)
+
+
+def run_profile(name, gbps, rtt_ms, mb, iters):
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer("127.0.0.1:0")
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_rank_main,
+            args=(r, 2, store.port, mb, iters, gbps, rtt_ms, out_q),
+        )
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        res = out_q.get(timeout=1200)
+        for p in procs:
+            p.join(timeout=120)
+    finally:
+        # failure path (rank crash -> queue stays empty): never orphan the
+        # rank processes or leak the store
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+        store.shutdown()
+    payload = mb * (1 << 20)
+    res.update(
+        profile=name,
+        gbps=gbps,
+        rtt_ms=rtt_ms,
+        mb=mb,
+        f32_ring_algo_gbps=round(payload / res["f32_ring_s"] / 1e9, 3),
+        quant_ring_algo_gbps=round(payload / res["quant_ring_s"] / 1e9, 3),
+        quant_speedup=round(res["f32_ring_s"] / res["quant_ring_s"], 3),
+    )
+    return {k: (round(v, 4) if isinstance(v, float) else v) for k, v in res.items()}
+
+
+def main():
+    ap = argparse.ArgumentParser("dcn_bench")
+    ap.add_argument("--mb", type=int, default=30,
+                    help="payload MB (~0.8B-param DiLoCo fragment at 30)")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--md", action="store_true",
+                    help="print a markdown table row block for RESULTS.md")
+    args = ap.parse_args()
+
+    rows = []
+    for name, gbps, rtt in PROFILES:
+        row = run_profile(name, gbps, rtt, args.mb, args.iters)
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+
+    if args.md:
+        print()
+        print(
+            "| profile | link | RTT | f32 ring | quant ring | quant speedup "
+            "| heal |"
+        )
+        print("|---|---|---|---|---|---|---|")
+        for r in rows:
+            link = "—" if not r["gbps"] else f"{r['gbps']:g} Gb/s"
+            rtt = "—" if not r["rtt_ms"] else f"{r['rtt_ms']:g} ms"
+            print(
+                f"| {r['profile']} | {link} | {rtt} "
+                f"| {r['f32_ring_s']*1e3:.0f} ms ({r['f32_ring_algo_gbps']} GB/s) "
+                f"| {r['quant_ring_s']*1e3:.0f} ms ({r['quant_ring_algo_gbps']} GB/s) "
+                f"| **{r['quant_speedup']}x** "
+                f"| {r['heal_s']*1e3:.0f} ms ({r['heal_gbps']:.2f} GB/s) |"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,9 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "bench_out.json")
+# private streaming artifact per trial — never clobbers the main bench's
+# bench_out.json (bench.py honors TPUFT_BENCH_OUT)
+OUT = os.path.join(REPO, ".mfu_sweep_trial.json")
 
 
 TRIAL_KEYS = ("remat", "block_q", "block_k", "batch")
@@ -58,6 +60,8 @@ def run_trial(trial: dict, steps: int, timeout_s: float) -> dict:
     env = dict(os.environ)
     env.update(
         {
+            "TPUFT_BENCH_OUT": OUT,
+            "TPUFT_BENCH_REPROBE_WINDOW_S": "0",
             "TPUFT_BENCH_SKIP_FLEET": "1",
             "TPUFT_BENCH_SKIP_DILOCO": "1",
             "TPUFT_BENCH_STEPS": str(steps),
@@ -115,6 +119,12 @@ def main() -> None:
     )
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument(
+        "--max-trials",
+        type=int,
+        default=None,
+        help="cap the trial count (e.g. a bounded TPU-window capture)",
+    )
     args = p.parse_args()
 
     trials = (
@@ -122,6 +132,8 @@ def main() -> None:
         if args.trials
         else list(default_grid())
     )
+    if args.max_trials is not None:
+        trials = trials[: args.max_trials]
     results = []
     for i, trial in enumerate(trials):
         print(f"[{i + 1}/{len(trials)}] {trial} ...", flush=True)
@@ -159,6 +171,13 @@ def main() -> None:
     best = (ok + by_tflops)[:1]
     if best:
         print(f"\nbest: {best[0]}")
+    # machine-readable capture for scripts/tpu_watch.py
+    sweep_out = os.environ.get("TPUFT_SWEEP_OUT")
+    if sweep_out:
+        with open(sweep_out, "w") as f:
+            json.dump(
+                {"results": results, "best": best[0] if best else None}, f
+            )
 
 
 if __name__ == "__main__":

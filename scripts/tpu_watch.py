@@ -1,0 +1,172 @@
+"""TPU-window watcher: convert ANY transient healthy-tunnel window into a
+captured real-TPU bench artifact (round-4 verdict item 1a).
+
+The axon tunnel wedges for hours at a time; rounds 3 and 4 shipped
+CPU-fallback artifacts because the one-shot bench run happened to land in a
+wedge.  This watcher runs for a whole build session: it re-probes the
+backend on an interval and, the FIRST time a probe round-trips real
+computation, immediately captures
+
+1. a phase-A bench artifact (MFU / tokens/sec/chip, ``bench.py`` with
+   ``TPUFT_BENCH_SKIP_FLEET=1``), and
+2. optionally the top ``mfu_sweep`` trials (``--sweep N``),
+
+then appends a timestamped entry to ``benchmarks/RESULTS.md`` and writes
+the JSON to ``tpu_watch_out.json`` at the repo root.  Exits after the
+first successful capture by default (``--forever`` keeps watching) so a
+later driver-run bench never contends with it for the exclusive chip.
+
+The watcher itself never imports jax — probes and benches run in bounded
+subprocesses, so a wedged tunnel can never wedge the watcher (or leave a
+dead jax process holding the tunnel).
+
+Usage:
+    python scripts/tpu_watch.py [--interval 300] [--sweep 0] [--forever]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT_JSON = os.path.join(REPO, "tpu_watch_out.json")
+RESULTS_MD = os.path.join(REPO, "benchmarks", "RESULTS.md")
+
+
+def _log(msg: str) -> None:
+    ts = datetime.datetime.now().strftime("%H:%M:%S")
+    print(f"tpu_watch[{ts}]: {msg}", file=sys.stderr, flush=True)
+
+
+def _probe(timeout_s: float) -> bool:
+    from torchft_tpu.utils.probe import backend_executes
+
+    return backend_executes(timeout_s=timeout_s, use_cache=False)
+
+
+def _run_phase_a(budget_s: float) -> dict | None:
+    """Run the phase-A bench via the capture protocol shared with bench.py's
+    mid-run recovery (one place to change env knobs / artifact keys)."""
+    import bench
+
+    _log(f"healthy probe — running phase A (budget {budget_s:.0f}s)")
+    return bench.capture_phase_a_subprocess(
+        budget_s=budget_s,
+        out_path=os.path.join(REPO, ".tpu_watch_phase_a.json"),
+        log=_log,
+    )
+
+
+def _run_sweep(trials: int, budget_s: float) -> dict | None:
+    env = dict(os.environ)
+    env.pop("TPUFT_BENCH_PLATFORM", None)
+    out_path = os.path.join(REPO, ".tpu_watch_sweep.json")
+    env["TPUFT_SWEEP_OUT"] = out_path
+    # same stale-artifact invariant as the phase-A capture: a timed-out
+    # sweep must not report the previous cycle's grid as this capture's
+    if os.path.exists(out_path):
+        os.remove(out_path)
+    try:
+        subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "mfu_sweep.py"),
+                "--max-trials",
+                str(trials),
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=sys.stderr,
+            timeout=budget_s,
+            check=False,
+        )
+        with open(out_path) as f:
+            return json.load(f)
+    except Exception as e:  # noqa: BLE001
+        _log(f"mfu sweep failed: {e}")
+        return None
+
+
+def _append_results_md(artifact: dict) -> None:
+    single = artifact.get("single", {})
+    stamp = datetime.datetime.now().isoformat(timespec="seconds")
+    lines = [
+        "",
+        f"## TPU window capture ({stamp}, scripts/tpu_watch.py)",
+        "",
+        f"- device: `{single.get('device_kind')}` "
+        f"(tier `{single.get('tier')}`, remat `{single.get('remat')}`, "
+        f"flash `{single.get('flash')}`)",
+        f"- fault-free: {single.get('faultfree_tokens_per_sec'):,} tok/s, "
+        f"{single.get('model_tflops_per_sec')} model TFLOP/s, "
+        f"**MFU {single.get('mfu')}**",
+        f"- FT stack ws=1: {single.get('ft_tokens_per_sec'):,} tok/s "
+        f"(ws1_ratio {single.get('ws1_ratio')}, mfu_ft {single.get('mfu_ft')})",
+        f"- full JSON: `tpu_watch_out.json`",
+    ]
+    with open(RESULTS_MD, "a") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("tpu_watch")
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between probes")
+    ap.add_argument("--probe-timeout", type=float, default=180.0)
+    ap.add_argument("--phase-a-budget", type=float, default=2400.0)
+    ap.add_argument("--sweep", type=int, default=0,
+                    help="also run N mfu_sweep trials after phase A")
+    ap.add_argument("--sweep-budget", type=float, default=3600.0)
+    ap.add_argument("--forever", action="store_true",
+                    help="keep watching after the first capture")
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600.0
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        t0 = time.time()
+        healthy = _probe(args.probe_timeout)
+        _log(
+            f"probe {attempt}: {'HEALTHY' if healthy else 'wedged'} "
+            f"({time.time() - t0:.0f}s)"
+        )
+        if healthy:
+            artifact = _run_phase_a(args.phase_a_budget)
+            if artifact is not None:
+                capture = {
+                    "captured_at": datetime.datetime.now().isoformat(
+                        timespec="seconds"
+                    ),
+                    "phase_a": artifact,
+                }
+                if args.sweep > 0:
+                    capture["mfu_sweep"] = _run_sweep(
+                        args.sweep, args.sweep_budget
+                    )
+                with open(OUT_JSON, "w") as f:
+                    json.dump(capture, f, indent=1)
+                _append_results_md(artifact)
+                single = artifact.get("single", {})
+                _log(
+                    f"CAPTURED TPU artifact: mfu={single.get('mfu')} "
+                    f"tflops={single.get('model_tflops_per_sec')} -> "
+                    f"{OUT_JSON} + RESULTS.md"
+                )
+                if not args.forever:
+                    return
+        time.sleep(max(5.0, args.interval - (time.time() - t0)))
+    _log("watch window expired with no healthy probe")
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,38 @@ class TestHealBreakdown:
             v >= 0 for v in bd.values() if isinstance(v, (int, float))
         )
 
+    def test_join_window_sub_attribution_telescopes(self):
+        """Round-4 verdict item 3: ~8.5 s of join_to_first_commit had no
+        bucket.  The worker now logs first_started / first_grads_ready /
+        first_quorum_ready inside the join window; the walk must attribute
+        them and leave only a small residual, with the buckets telescoping
+        to exactly kill→rejoin."""
+        kill, rejoin = 100.0, 115.0
+        recs = _phases(
+            7,
+            kill,
+            ("proc_start", 1.0),
+            ("jax_ready", 3.0),
+            ("model_ready", 5.0),
+            ("manager_ready", 6.0),
+            ("first_started", 6.2),
+            ("first_grads_ready", 10.0),
+            ("first_quorum_ready", 14.0),
+        )
+        recs.append({"step": 9, "ts": rejoin, "pid": 7})
+        bd = bench._heal_breakdown(recs, kill, rejoin, 7)
+        assert bd["sane"] is True
+        assert bd["first_loop_s"] == 0.2
+        assert bd["first_grads_s"] == 3.8
+        assert bd["quorum_wait_s"] == 4.0
+        assert bd["join_to_first_commit_s"] == 1.0
+        total = sum(v for v in bd.values() if isinstance(v, float))
+        assert abs(total - (rejoin - kill)) < 0.01
+        # the formerly-opaque bucket is now a small residual, not the
+        # majority of the heal
+        attributed = total - bd["join_to_first_commit_s"]
+        assert attributed / total > 0.9
+
     def test_legacy_records_without_pid_still_attribute(self):
         kill, rejoin = 10.0, 14.0
         recs = [

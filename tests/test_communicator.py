@@ -362,3 +362,41 @@ def test_reduce_scatter_does_not_mutate_input(store) -> None:
         return True
 
     assert all(_run_ranks(store, 2, _fn))
+
+
+class TestNetEmu:
+    """The netem-style sender pacer behind TORCHFT_NET_GBPS/RTT_MS
+    (benchmarks/dcn_bench.py drives it end-to-end)."""
+
+    def test_rate_cap_and_idle_burst_bound(self):
+        from torchft_tpu.communicator import _NetEmu
+
+        emu = _NetEmu(gbps=1.0, rtt_ms=0.0)
+        # idle credit must be capped at the burst size, not accrue forever
+        time.sleep(0.05)
+        assert emu.allow(10 << 20) <= emu.burst
+        # draining the bucket throttles the next allowance
+        emu.consume(emu.allow(emu.burst))
+        assert emu.allow(1 << 20) < (1 << 20)
+
+    def test_zero_length_frames_never_gated(self, store) -> None:
+        """ws=2 rings carry a zero-size chunk (1-element barrier payload
+        split over 2 ranks); the pacer must not park on the empty frame —
+        this wedged the first dcn_bench run."""
+        import os
+
+        def _fn(comm, rank):
+            comm.barrier().wait(timeout=30.0)
+            out = comm.allreduce(
+                np.ones(1, dtype=np.float32), ReduceOp.SUM
+            ).wait(timeout=30.0)
+            return float(np.asarray(out).reshape(-1)[0])
+
+        os.environ["TORCHFT_NET_GBPS"] = "1.0"
+        os.environ["TORCHFT_NET_RTT_MS"] = "1.0"
+        try:
+            results = _run_ranks(store, 2, _fn)
+        finally:
+            os.environ.pop("TORCHFT_NET_GBPS", None)
+            os.environ.pop("TORCHFT_NET_RTT_MS", None)
+        assert results == [2.0, 2.0]

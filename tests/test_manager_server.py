@@ -185,12 +185,41 @@ class TestManagerServer:
         finally:
             mgr.shutdown()
 
-    def test_get_quorum_heal_first_step(self, lighthouse) -> None:
+    def test_get_quorum_heal_first_step(self) -> None:
         """Two fresh replicas at step 0 with init_sync → exactly one heals
-        (``src/manager.rs:761-832``)."""
+        (``src/manager.rs:761-832``).
+
+        Uses its OWN lighthouse with a generous join window: the shared
+        fixture's 100 ms window makes the outcome depend on both quorum
+        RPCs landing within 100 ms of each other, which a loaded CI box
+        does not guarantee (the first request would form a 1-replica
+        quorum with no heal — a scheduling artifact, not the semantics
+        under test).  With both replicas heartbeating, the quorum still
+        forms the instant the second request arrives (fast quorum), so the
+        long window costs nothing on a healthy box."""
+        lighthouse = LighthouseServer(
+            bind="127.0.0.1:0",
+            min_replicas=1,
+            join_timeout_ms=10_000,
+            quorum_tick_ms=10,
+        )
         mgr0 = _manager(lighthouse, "rep_0")
         mgr1 = _manager(lighthouse, "rep_1")
         try:
+            # wait for BOTH heartbeats to register: a quorum request that
+            # lands while the lighthouse knows only one live replica forms
+            # a fast 1-replica quorum (no heal) regardless of the window
+            from torchft_tpu.lighthouse import LighthouseClient
+
+            lc = LighthouseClient(lighthouse.local_address())
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                beats = lc.status().get("heartbeats", {})
+                if {"rep_0", "rep_1"} <= set(beats):
+                    break
+                time.sleep(0.02)
+            lc.close()
+
             results: List[Optional[object]] = [None, None]
 
             def _ask(i: int, mgr: ManagerServer) -> None:
@@ -200,7 +229,7 @@ class TestManagerServer:
                     step=0,
                     checkpoint_metadata=f"meta_{i}",
                     shrink_only=False,
-                    timeout=10.0,
+                    timeout=30.0,
                 )
                 client.close()
 
@@ -211,7 +240,7 @@ class TestManagerServer:
             for t in threads:
                 t.start()
             for t in threads:
-                t.join(timeout=10.0)
+                t.join(timeout=35.0)
 
             assert results[0] is not None and results[1] is not None
             heals = [r.heal for r in results]
@@ -223,6 +252,7 @@ class TestManagerServer:
         finally:
             mgr0.shutdown()
             mgr1.shutdown()
+            lighthouse.shutdown()
 
     def test_should_commit(self, lighthouse) -> None:
         """AND of votes across the group (``src/manager.rs:657-703``)."""

@@ -95,3 +95,22 @@ class TestRehearsalCatchesBadConfigs:
             lower=True,
         )
         assert r.ok, r.summary()
+
+
+class TestQuantKernelLowering:
+    def test_all_quant_kernels_lower_for_tpu(self):
+        """Round-4 verdict item 9: every device quant kernel (quantize /
+        fused reduce / dequantize) x every wire kind must TPU-lower — a
+        Mosaic-inexpressible program fails here in CI, not at cluster
+        bring-up.  Per-generation compile still needs metal (covered at
+        runtime by pallas_quant._pallas_kind_ok)."""
+        from torchft_tpu.parallel.rehearsal import quant_kernel_reports
+
+        rows = quant_kernel_reports()
+        assert {(r["kernel"], r["kind"]) for r in rows} == {
+            (k, w)
+            for k in ("quantize", "reduce", "dequantize")
+            for w in ("int8", "fp8")
+        }
+        failed = [r for r in rows if not r["lowered"]]
+        assert not failed, failed

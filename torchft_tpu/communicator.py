@@ -31,6 +31,7 @@ that runs anywhere; the same interface is implemented by the C++ runtime
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import select
 import socket
@@ -191,6 +192,63 @@ class Communicator(ABC):
 _HDR = struct.Struct("<QQ")  # payload nbytes, tag
 
 
+class _NetEmu:
+    """Deterministic sender-side network emulation (netem analog) for the
+    TCP tier: a token-bucket bandwidth cap plus a half-RTT gate before each
+    frame's first byte.  Loopback hides the regime the replica dimension is
+    designed for (DCN: ~1-10 Gb/s, 2-10 ms RTT); with this, ring / quantized
+    ring / heal-transfer behavior at DCN profiles is measured rather than
+    extrapolated (``benchmarks/dcn_bench.py``).  Enabled only via env —
+    ``TORCHFT_NET_GBPS`` (link rate, Gbit/s) and ``TORCHFT_NET_RTT_MS`` —
+    and never in production paths by default."""
+
+    def __init__(self, gbps: float, rtt_ms: float) -> None:
+        self.bytes_per_s = gbps * 1e9 / 8.0
+        self.half_rtt_s = rtt_ms / 2e3
+        # classic capped token bucket: credit must NOT accrue while idle,
+        # or the first send after any pause bursts at loopback speed and
+        # the measured rate exceeds the emulated link
+        self.burst = max(64 << 10, int(self.bytes_per_s * 0.005))
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+
+    def frame_gate(self) -> float:
+        """Earliest monotonic time the next frame may start transmitting."""
+        return time.monotonic() + self.half_rtt_s
+
+    def allow(self, want: int) -> int:
+        """Bytes the token bucket permits right now (<= ``want``)."""
+        if self.bytes_per_s <= 0:
+            return want
+        now = time.monotonic()
+        self._tokens = min(
+            float(self.burst),
+            self._tokens + (now - self._last) * self.bytes_per_s,
+        )
+        self._last = now
+        return max(0, min(want, int(self._tokens)))
+
+    def consume(self, n: int) -> None:
+        self._tokens -= n
+
+
+def _net_emu_from_env() -> Optional["_NetEmu"]:
+    try:
+        gbps = float(os.environ.get("TORCHFT_NET_GBPS", "0") or 0.0)
+        rtt_ms = float(os.environ.get("TORCHFT_NET_RTT_MS", "0") or 0.0)
+    except ValueError as e:
+        # loud, not silent: an unparseable knob ("10g") would otherwise run
+        # UNSHAPED and record loopback numbers as a DCN profile
+        raise CommunicatorError(
+            "unparseable network-emulation knob: "
+            f"TORCHFT_NET_GBPS={os.environ.get('TORCHFT_NET_GBPS')!r} "
+            f"TORCHFT_NET_RTT_MS={os.environ.get('TORCHFT_NET_RTT_MS')!r}"
+        ) from e
+    if gbps <= 0 and rtt_ms <= 0:
+        return None
+    return _NetEmu(gbps, rtt_ms)
+
+
 class _TcpMesh:
     """Full mesh of rank-to-rank sockets for one quorum epoch.
 
@@ -211,6 +269,8 @@ class _TcpMesh:
         self.world_size = world_size
         self._aborted = threading.Event()
         self.peers: Dict[int, socket.socket] = {}
+        # netem-style pacing (off unless TORCHFT_NET_GBPS/RTT_MS set)
+        self._emu = _net_emu_from_env()
 
         store = create_store_client(store_addr, timeout=timeout_s)
 
@@ -380,9 +440,13 @@ class _TcpMesh:
         neighbor while receiving from its left without ordering constraints.
         """
         send_state = {}
+        frame_gates: Dict[int, float] = {}
         for peer, tag, view in sends:
             header = _HDR.pack(len(view), tag)
             send_state[peer] = [memoryview(header), view]
+            if self._emu is not None:
+                # half-RTT before the frame's first byte leaves
+                frame_gates[peer] = self._emu.frame_gate()
         recv_state = {}
         for peer, tag, view in recvs:
             recv_state[peer] = {
@@ -400,14 +464,31 @@ class _TcpMesh:
             wlist = [self.peers[p] for p in send_state]
             readable, writable, _ = select.select(rlist, wlist, [], 0.1)
 
+            paced_block = False
             for sock in writable:
                 peer = next(p for p, s in self.peers.items() if s is sock)
                 bufs = send_state.get(peer)
                 if bufs is None:
                     continue
+                if self._emu is not None and time.monotonic() < frame_gates.get(
+                    peer, 0.0
+                ):
+                    paced_block = True
+                    continue
                 try:
                     while bufs:
-                        sent = sock.send(bufs[0])
+                        chunk = bufs[0]
+                        # len 0 = a zero-payload frame's body (e.g. the empty
+                        # ring chunk at ws=2): nothing to pace — send() pops it
+                        if self._emu is not None and len(chunk) > 0:
+                            allowed = self._emu.allow(len(chunk))
+                            if allowed <= 0:
+                                paced_block = True
+                                break
+                            chunk = chunk[:allowed]
+                        sent = sock.send(chunk)
+                        if self._emu is not None:
+                            self._emu.consume(sent)
                         if sent == len(bufs[0]):
                             bufs.pop(0)
                         else:
@@ -458,6 +539,11 @@ class _TcpMesh:
                 # zero-length) is fully received
                 if len(st["hdr"]) == _HDR.size and st["off"] == len(st["view"]):
                     del recv_state[peer]
+
+            if paced_block:
+                # socket writable but the pacer denied bytes — select would
+                # return immediately and spin the op thread hot
+                time.sleep(0.0005)
 
 
 def _recv_exact(
