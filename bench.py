@@ -43,8 +43,10 @@ TPUFT_BENCH_REMAT, TPUFT_BENCH_PLATFORM, TPUFT_BENCH_FLEET_STEPS,
 TPUFT_BENCH_KILL_EVERY, TPUFT_BENCH_REPLICAS, TPUFT_BENCH_SKIP_FLEET,
 TPUFT_BENCH_SKIP_DILOCO, TPUFT_BENCH_DILOCO_QUANT (0/1/auto),
 TPUFT_BENCH_OUT (streaming artifact path), TPUFT_BENCH_REPROBE_WINDOW_S /
-TPUFT_BENCH_REPROBE_BUDGET_S (mid-run TPU recovery), TPUFT_PEAK_TFLOPS,
-TORCHFT_TIER.
+TPUFT_BENCH_REPROBE_BUDGET_S (mid-run TPU recovery),
+TPUFT_BENCH_TOTAL_BUDGET_S (wall-clock bound; phases shrink/skip to fit),
+TPUFT_BENCH_HEAL_TRANSPORT (comm|http — heal over the collective fabric
+vs the reference-parity HTTP server), TPUFT_PEAK_TFLOPS, TORCHFT_TIER.
 
 Output contract: stdout's LAST line is one compact headline JSON (<=~1 KB,
 survives a 2000-char tail capture); the full nested artifact streams to
@@ -342,14 +344,26 @@ def worker_main() -> None:
         ev.phase("standby_promoted")
 
     tier = tier_mod.default_tier()
+    comm = tier_mod.make_communicator(timeout_s=30.0, tier=tier)
+    transport = None
+    if os.environ.get("TPUFT_BENCH_HEAL_TRANSPORT", "comm") == "comm":
+        # heal over the collective fabric (CommTransport) instead of HTTP:
+        # same wire the gradients ride, ~an order of magnitude faster per
+        # transfer under multi-replica contention (benchmarks/RESULTS.md
+        # dcn_bench heal column vs the r5 HTTP heal_recv_s) — HTTP stays
+        # selectable for the reference-parity path
+        from torchft_tpu.checkpointing.comm_transport import CommTransport
+
+        transport = CommTransport(comm, timeout=60.0)
     manager = Manager(
-        comm=tier_mod.make_communicator(timeout_s=30.0, tier=tier),
+        comm=comm,
         load_state_dict=lambda s: holder.update(s),
         state_dict=lambda: dict(holder),
         min_replica_size=1,
         replica_id=f"bench_{rg}",
         use_async_quorum=(mode == "ddp"),
         server_cls=tier_mod.manager_server_cls(tier),
+        checkpoint_transport=transport,
     )
     ev.phase("manager_ready", tier=tier)
 
@@ -1230,25 +1244,34 @@ def _try_tpu_phase_a(
     if window <= 0:
         return None
     budget = float(os.environ.get("TPUFT_BENCH_REPROBE_BUDGET_S", "1500"))
+    probe_timeout = float(os.environ.get("TPUFT_BENCH_PROBE_TIMEOUT_S", "180"))
     if max_total_s is not None:
         # the recovery must not push the run past the total wall-clock
         # budget — overrunning is exactly the lost-final-line failure the
-        # budget exists to prevent
-        if max_total_s < window + 240.0:
+        # budget exists to prevent.  The probe's LAST attempt can run past
+        # the window by a full probe timeout, so reserve that too.
+        if max_total_s < window + probe_timeout + 240.0:
             log(
                 f"skipping TPU recovery: {max_total_s:.0f}s of total budget "
-                "left (< probe window + minimum capture time)"
+                "left (< probe window + probe timeout + minimum capture)"
             )
             return None
-        budget = min(budget, max_total_s - window)
     log(f"re-probing TPU backend for {window:.0f}s (mid-run recovery)")
+    t_probe = time.time()
     if not backend_executes_with_retries(
         window_s=window,
-        timeout_s=float(os.environ.get("TPUFT_BENCH_PROBE_TIMEOUT_S", "180")),
+        timeout_s=probe_timeout,
         log=log,
     ):
         log("re-probe failed; keeping the CPU artifact")
         return None
+    if max_total_s is not None:
+        # clamp to what probing actually left over, minus an emit/teardown
+        # margin — the parent still prints the headline AFTER the capture
+        budget = min(budget, max_total_s - (time.time() - t_probe) - 60.0)
+        if budget < 180.0:
+            log("skipping TPU recovery: probe consumed the budget")
+            return None
     log("TPU healthy on re-probe: running phase A in a subprocess")
     artifact = capture_phase_a_subprocess(budget_s=budget, log=log)
     return artifact.get("single") if artifact else None

@@ -95,9 +95,8 @@ def _run_sweep(trials: int, budget_s: float) -> dict | None:
         return None
 
 
-def _append_results_md(artifact: dict) -> None:
+def _append_results_md(artifact: dict, json_name: str, stamp: str) -> None:
     single = artifact.get("single", {})
-    stamp = datetime.datetime.now().isoformat(timespec="seconds")
     lines = [
         "",
         f"## TPU window capture ({stamp}, scripts/tpu_watch.py)",
@@ -110,7 +109,7 @@ def _append_results_md(artifact: dict) -> None:
         f"**MFU {single.get('mfu')}**",
         f"- FT stack ws=1: {single.get('ft_tokens_per_sec'):,} tok/s "
         f"(ws1_ratio {single.get('ws1_ratio')}, mfu_ft {single.get('mfu_ft')})",
-        f"- full JSON: `tpu_watch_out.json`",
+        f"- full JSON: `{json_name}`",
     ]
     with open(RESULTS_MD, "a") as f:
         f.write("\n".join(lines) + "\n")
@@ -143,19 +142,23 @@ def main() -> None:
         if healthy:
             artifact = _run_phase_a(args.phase_a_budget)
             if artifact is not None:
-                capture = {
-                    "captured_at": datetime.datetime.now().isoformat(
-                        timespec="seconds"
-                    ),
-                    "phase_a": artifact,
-                }
+                stamp = datetime.datetime.now().isoformat(timespec="seconds")
+                capture = {"captured_at": stamp, "phase_a": artifact}
                 if args.sweep > 0:
                     capture["mfu_sweep"] = _run_sweep(
                         args.sweep, args.sweep_budget
                     )
-                with open(OUT_JSON, "w") as f:
-                    json.dump(capture, f, indent=1)
-                _append_results_md(artifact)
+                # stable name = latest capture; timestamped copy so every
+                # RESULTS.md entry keeps its backing artifact under
+                # --forever (each entry cites its own file)
+                stamped = os.path.join(
+                    REPO,
+                    f"tpu_watch_out_{stamp.replace(':', '')}.json",
+                )
+                for path in (OUT_JSON, stamped):
+                    with open(path, "w") as f:
+                        json.dump(capture, f, indent=1)
+                _append_results_md(artifact, os.path.basename(stamped), stamp)
                 single = artifact.get("single", {})
                 _log(
                     f"CAPTURED TPU artifact: mfu={single.get('mfu')} "
