@@ -140,11 +140,12 @@ def _sizes(on_cpu: bool) -> Dict[str, int]:
         "head_dim": env_int("TPUFT_BENCH_HEAD_DIM", 64, 128),
         "remat": env_int("TPUFT_BENCH_REMAT", 0, 1),
         # CPU-fallback fleet sizes amortize heal cost honestly: at 48 steps
-        # and a kill every 16 the per-100-step normalization sees 3 kills
-        # averaged over a real steady phase rather than 2 kills dominating
-        # a 16-step blip (the round-4 artifact's 0.9485 was exactly that)
+        # and a kill every 14 the per-100-step normalization sees 3 kills
+        # (14/28/42 — a 16-step cadence lands the third ON the target step
+        # and loses it) averaged over a real steady phase rather than 2
+        # kills dominating a 16-step blip (the round-4 artifact's 0.9485)
         "fleet_steps": env_int("TPUFT_BENCH_FLEET_STEPS", 48, 100),
-        "kill_every": env_int("TPUFT_BENCH_KILL_EVERY", 16, 25),
+        "kill_every": env_int("TPUFT_BENCH_KILL_EVERY", 14, 25),
         # 3 replicas even on CPU: victim rotation + the cold last victim
         # record BOTH heal paths (standby + cold) in one artifact
         "replicas": env_int("TPUFT_BENCH_REPLICAS", 3, 3),
@@ -1486,6 +1487,16 @@ def _quant_device_reduce_active() -> Tuple[bool, str]:
     return active, "auto (tpu backend, >=256KiB shards)"
 
 
+def _budget_left(
+    deadline_ts: Optional[float], frac: float, floor: float
+) -> Optional[float]:
+    """A fleet's share of what's left of the phase budget (None = no
+    bound) — one policy for the fault-free and churn fleets alike."""
+    if deadline_ts is None:
+        return None
+    return max(floor, (deadline_ts - time.time()) * frac)
+
+
 def _run_diloco_phase(
     sizes: Dict[str, int],
     worker_platform: Optional[str],
@@ -1505,12 +1516,6 @@ def _run_diloco_phase(
     mode = _diloco_quant_env()
     ff_target = max(12, sizes["diloco_steps"] // 2)
 
-    def _left(frac: float, floor: float) -> Optional[float]:
-        # bound each fleet by a share of what's left of the total budget
-        if deadline_ts is None:
-            return None
-        return max(floor, (deadline_ts - time.time()) * frac)
-
     def _faultfree(tag: str, quant: bool) -> Dict[str, Any]:
         r = run_fleet(
             f"diloco_faultfree_{tag}",
@@ -1520,7 +1525,7 @@ def _run_diloco_phase(
             replicas=replicas,
             mode="diloco",
             extra_env={"TPUFT_BENCH_DILOCO_QUANT_WIRE": "1" if quant else "0"},
-            deadline_s=_left(0.25, 90.0),
+            deadline_s=_budget_left(deadline_ts, 0.25, 90.0),
         )
         print(f"bench: diloco fault-free [{tag}] {r}", file=sys.stderr)
         return r
@@ -1528,6 +1533,23 @@ def _run_diloco_phase(
     ff_by_wire: Dict[str, Dict[str, Any]] = {}
     if mode == "auto":
         ff_by_wire["f32"] = _faultfree("f32", quant=False)
+        budget_left = (
+            None if deadline_ts is None else deadline_ts - time.time()
+        )
+        if budget_left is not None and budget_left < 360.0:
+            # starve the A/B before the churn run, never the reverse — the
+            # churn ratio is the phase's headline number
+            faultfree = ff_by_wire["f32"]
+            use_quant = False
+            gate = "auto"
+            gate_reason = (
+                f"quant A/B skipped: {budget_left:.0f}s of budget left is "
+                "reserved for the churn run"
+            )
+            return _diloco_churn_and_summary(
+                sizes, worker_platform, replicas, deadline_ts,
+                ff_by_wire, faultfree, use_quant, gate, gate_reason,
+            )
         ff_by_wire["quant"] = _faultfree("quant", quant=True)
         so_f = ff_by_wire["f32"].get("sync_overhead_s")
         so_q = ff_by_wire["quant"].get("sync_overhead_s")
@@ -1551,7 +1573,25 @@ def _run_diloco_phase(
         gate = "forced"
         gate_reason = f"TPUFT_BENCH_DILOCO_QUANT={mode}"
     faultfree = ff_by_wire["quant" if use_quant else "f32"]
+    return _diloco_churn_and_summary(
+        sizes, worker_platform, replicas, deadline_ts,
+        ff_by_wire, faultfree, use_quant, gate, gate_reason,
+    )
 
+
+def _diloco_churn_and_summary(
+    sizes: Dict[str, int],
+    worker_platform: Optional[str],
+    replicas: int,
+    deadline_ts: Optional[float],
+    ff_by_wire: Dict[str, Dict[str, Any]],
+    faultfree: Dict[str, Any],
+    use_quant: bool,
+    gate: str,
+    gate_reason: str,
+) -> Dict[str, Any]:
+    """Churn run + phase-D artifact assembly, shared by the full A/B path
+    and the budget-starved early path."""
     churn = run_fleet(
         "diloco_churn",
         target_steps=sizes["diloco_steps"],
@@ -1566,7 +1606,7 @@ def _run_diloco_phase(
         kill_in_sync_window=True,
         max_kills=sizes["diloco_kills"],
         extra_env={"TPUFT_BENCH_DILOCO_QUANT_WIRE": "1" if use_quant else "0"},
-        deadline_s=_left(0.9, 180.0),
+        deadline_s=_budget_left(deadline_ts, 0.9, 180.0),
     )
     print(f"bench: diloco churn {churn}", file=sys.stderr)
     out: Dict[str, Any] = {
