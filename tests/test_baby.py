@@ -213,7 +213,10 @@ def test_baby_shm_broadcast_and_arena_reuse(store) -> None:
 def test_baby_kill_recovers(store) -> None:
     """Killing the child (a wedge no abort can reach) fails in-flight work
     and a reconfigure respawns a healthy child."""
-    comm = BabyCommunicator(timeout_s=10.0)
+    # 30 s like every other test here: the spawned child pays ~3 s of
+    # interpreter boot (sitecustomize imports jax) and multiples of that
+    # under CI load — 10 s made configure()'s child-ready wait flaky
+    comm = BabyCommunicator(timeout_s=30.0)
     comm.configure(
         f"127.0.0.1:{store.port}/solo", replica_id="r", rank=0, world_size=1
     )
