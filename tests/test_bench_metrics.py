@@ -1,11 +1,14 @@
-"""Heal-attribution math of the bench harness.
+"""Bench-harness logic tests: heal attribution, the phase-A remat walk,
+fleet-metric aggregation, the DiLoCo quantized-wire A/B gate, and the
+phase-A TPU-capture guards.
 
-The round-3 artifact showed ``promote_s = -5.44``: the promoted standby and
-the fresh spare re-warmed behind it interleave in one replica log, and the
-old phase walk attributed the spare's boot to the heal.  The fix keys every
-event by writer pid and attributes a kill only to the incarnation that
-logged the rejoin step.  The reference measures heal timings in its manager
-integration harness (``torchft/manager_integ_test.py:340-430``).
+Heal attribution history: the round-3 artifact showed ``promote_s =
+-5.44`` — the promoted standby and the fresh spare re-warmed behind it
+interleave in one replica log, and the old phase walk attributed the
+spare's boot to the heal.  The fix keys every event by writer pid and
+attributes a kill only to the incarnation that logged the rejoin step.
+The reference measures heal timings in its manager integration harness
+(``torchft/manager_integ_test.py:340-430``).
 """
 
 import bench
@@ -202,3 +205,126 @@ class TestFleetMetricsAggregation:
         assert res["heal_in_s"] == [6.0, 2.0]
         assert len(res["heal_breakdowns"]) == 2
         assert res["heal_in_s_by_path"] == {"cold": 6.0, "standby": 2.0}
+
+
+class TestDilocoQuantGate:
+    """The measured A/B gate for the DiLoCo pseudogradient wire (round-5
+    verdict item 4): both wires recorded, churn uses the measured winner,
+    budget starvation degrades to f32 + reason instead of starving churn."""
+
+    def _run(self, monkeypatch, overheads, deadline_in=None, env=None):
+        import time as _time
+
+        calls = []
+
+        def fake_run_fleet(label, **kw):
+            calls.append((label, kw.get("extra_env", {})))
+            r = {"label": label, "kills": kw.get("max_kills") or 0,
+                 "t_step_s": 1.0, "completed": True,
+                 "ratio_per_100step_kill": 0.99}
+            for wire, so in overheads.items():
+                if label.endswith(wire) and so is not None:
+                    r["sync_overhead_s"] = so
+            return r
+
+        monkeypatch.setattr(bench, "run_fleet", fake_run_fleet)
+        if env is not None:
+            monkeypatch.setenv("TPUFT_BENCH_DILOCO_QUANT", env)
+        else:
+            monkeypatch.delenv("TPUFT_BENCH_DILOCO_QUANT", raising=False)
+        sizes = {
+            "diloco_steps": 48, "diloco_sync_every": 8,
+            "diloco_fragments": 2, "diloco_sync_delay": 2,
+            "diloco_kills": 3,
+        }
+        deadline = None if deadline_in is None else _time.time() + deadline_in
+        out = bench._run_diloco_phase(sizes, "cpu", 3, deadline_ts=deadline)
+        return out, calls
+
+    def test_auto_records_both_and_picks_cheaper(self, monkeypatch):
+        out, calls = self._run(monkeypatch, {"f32": 0.4, "quant": 0.2})
+        assert out["quantized_sync"] is True
+        assert out["sync_overhead_s_f32"] == 0.4
+        assert out["sync_overhead_s_quant"] == 0.2
+        assert out["quant_vs_f32_sync_overhead"] == 0.5
+        assert "faultfree_alt" in out
+        churn_env = [e for (l, e) in calls if l == "diloco_churn"][0]
+        assert churn_env["TPUFT_BENCH_DILOCO_QUANT_WIRE"] == "1"
+
+    def test_auto_keeps_f32_when_quant_measures_slower(self, monkeypatch):
+        out, calls = self._run(monkeypatch, {"f32": 0.2, "quant": 0.4})
+        assert out["quantized_sync"] is False
+        assert out["quant_vs_f32_sync_overhead"] == 2.0
+        churn_env = [e for (l, e) in calls if l == "diloco_churn"][0]
+        assert churn_env["TPUFT_BENCH_DILOCO_QUANT_WIRE"] == "0"
+
+    def test_auto_falls_back_when_overheads_missing(self, monkeypatch):
+        out, calls = self._run(monkeypatch, {"f32": None, "quant": None})
+        assert out["quantized_sync"] is False
+        assert "sync_overhead_s missing" in out["quant_gate_reason"]
+        # the alternate run is still in the artifact, never discarded
+        assert "faultfree_alt" in out
+
+    def test_budget_starved_skips_ab_not_churn(self, monkeypatch):
+        out, calls = self._run(
+            monkeypatch, {"f32": 0.4, "quant": 0.2}, deadline_in=200.0
+        )
+        labels = [l for (l, _e) in calls]
+        assert "diloco_faultfree_quant" not in labels  # A/B starved...
+        assert "diloco_churn" in labels  # ...churn never is
+        assert out["quantized_sync"] is False
+        assert "reserved for the churn run" in out["quant_gate_reason"]
+
+    def test_forced_wire_skips_ab(self, monkeypatch):
+        out, calls = self._run(monkeypatch, {"quant": 0.2}, env="1")
+        labels = [l for (l, _e) in calls]
+        assert labels == ["diloco_faultfree_quant", "diloco_churn"]
+        assert out["quantized_sync"] is True
+        assert out["quant_gate"] == "forced"
+
+
+class TestPhaseACaptureGuards:
+    """capture_phase_a_subprocess (shared by the mid-run recovery and
+    scripts/tpu_watch.py) must never pass off a stale or CPU artifact as a
+    TPU capture."""
+
+    def _capture(self, monkeypatch, tmp_path, artifact, write=True):
+        import json as _json
+        import subprocess as _sp
+
+        out_path = str(tmp_path / "phase_a.json")
+
+        def fake_run(cmd, **kw):
+            if write:
+                with open(kw["env"]["TPUFT_BENCH_OUT"], "w") as f:
+                    _json.dump(artifact, f)
+            return _sp.CompletedProcess(cmd, 0)
+
+        # capture_phase_a_subprocess does `import subprocess` at call time,
+        # so patching the global module object covers it
+        import subprocess
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        return bench.capture_phase_a_subprocess(60.0, out_path=out_path)
+
+    def test_accepts_tpu_artifact(self, monkeypatch, tmp_path):
+        art = {"cpu_fallback": False, "single": {"platform": "tpu", "mfu": 0.5}}
+        got = self._capture(monkeypatch, tmp_path, art)
+        assert got is not None and got["single"]["mfu"] == 0.5
+
+    def test_rejects_cpu_platform_even_without_fallback_flag(
+        self, monkeypatch, tmp_path
+    ):
+        art = {"cpu_fallback": False, "single": {"platform": "cpu"}}
+        assert self._capture(monkeypatch, tmp_path, art) is None
+
+    def test_stale_artifact_removed_before_capture(self, monkeypatch, tmp_path):
+        stale = tmp_path / "phase_a.json"
+        stale.write_text('{"single": {"platform": "tpu"}, "cpu_fallback": false}')
+        # subprocess dies before writing: the stale file must NOT be read
+        assert (
+            self._capture(
+                monkeypatch, tmp_path, artifact=None, write=False
+            )
+            is None
+        )
