@@ -44,7 +44,10 @@ TPUFT_BENCH_KILL_EVERY, TPUFT_BENCH_REPLICAS, TPUFT_BENCH_SKIP_FLEET,
 TPUFT_BENCH_SKIP_DILOCO, TPUFT_BENCH_DILOCO_QUANT (0/1/auto),
 TPUFT_BENCH_OUT (streaming artifact path), TPUFT_BENCH_REPROBE_WINDOW_S /
 TPUFT_BENCH_REPROBE_BUDGET_S (mid-run TPU recovery),
-TPUFT_BENCH_TOTAL_BUDGET_S (wall-clock bound; phases shrink/skip to fit),
+TPUFT_BENCH_TOTAL_BUDGET_S (wall-clock bound incl. the initial probe;
+phases shrink/skip to fit — except a wedged-tunnel probe only eats the
+budget down to TPUFT_BENCH_PHASE_FLOOR_S, so the hard worst case is
+probe window + probe timeout + floor),
 TPUFT_BENCH_HEAL_TRANSPORT (comm|http — heal over the collective fabric
 vs the reference-parity HTTP server), TPUFT_PEAK_TFLOPS, TORCHFT_TIER.
 
@@ -1282,9 +1285,16 @@ def main() -> None:
     # total wall-clock budget: a driver that kills a long bench would
     # capture NO final JSON line at all, so the bench bounds itself and
     # prints whatever phases completed (the streaming bench_out.json plus
-    # this guarantee = an artifact on every path)
-    t_start = time.time()
+    # this guarantee = an artifact on every path).  The initial probe
+    # counts against the budget, but a wedged tunnel (900 s probe window)
+    # must not starve the measurement phases into a degraded artifact on
+    # exactly the runs where the CPU numbers are all there is — so the
+    # phases keep a floor (default 1500 s) and the hard worst case is
+    # probe window + floor (~40 min at defaults).
     budget_s = float(os.environ.get("TPUFT_BENCH_TOTAL_BUDGET_S", "2100"))
+    phase_floor_s = float(os.environ.get("TPUFT_BENCH_PHASE_FLOOR_S", "1500"))
+    t_probe_start = time.time()
+    t_start = t_probe_start
 
     def remaining_s() -> float:
         return budget_s - (time.time() - t_start)
@@ -1303,6 +1313,14 @@ def main() -> None:
             file=sys.stderr,
         )
         platform = "cpu"
+    # probe done: charge it to the budget; the floor only compensates for
+    # probe time actually spent and never raises an explicitly smaller
+    # budget (a caller sizing a kill timeout to its env value must win)
+    budget_s = max(
+        min(phase_floor_s, budget_s),
+        budget_s - (time.time() - t_probe_start),
+    )
+    t_start = time.time()
     _configure_jax(platform)
 
     import jax
