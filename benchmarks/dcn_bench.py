@@ -10,6 +10,10 @@ tolerance under the TCP tier's netem-style sender pacer
 - ``f32 ring``:   plain SUM-allreduce of a gradient-sized payload
 - ``quant ring``: the int8 windowed pipelined allreduce (4x less wire)
 - ``heal``:       a CommTransport checkpoint send/recv (victim rejoin path)
+- ``striped heal``: the same heal fetched as disjoint chunk ranges from 1
+  vs 2 sources in a 3-replica group (``recv_checkpoint_striped``) — heal
+  bandwidth must scale with source count because each sender paces its own
+  emulated link (the multi-peer striped-healing claim, PHOENIX-style)
 
 at a set of profiles including unshaped loopback as the control.  The
 quantized ring must BEAT the f32 ring at the constrained profiles — that is
@@ -17,7 +21,12 @@ the claim that justifies its existence — while on unshaped loopback it may
 lose (host quantize cycles the fat link never repays; exactly why the
 DiLoCo quant gate is measurement-driven, ``bench.py``).
 
+Throughput keys are suffixed ``_GBps`` (gigaBYTES/s) — deliberately NOT
+``gbps``, so they cannot be misread 8x against the profiles' Gbit/s link
+rates (the ``gbps`` profile field).
+
 Usage: python benchmarks/dcn_bench.py [--mb 30] [--iters 3] [--md]
+       [--no-striped]
 """
 
 from __future__ import annotations
@@ -87,11 +96,77 @@ def _rank_main(rank, world, port, mb, iters, gbps, rtt_ms, out_q):
             got = transport.recv_checkpoint(0, "", step=i, timeout=300.0)
             assert got["params"].nbytes == state["params"].nbytes
     results["heal_s"] = (time.perf_counter() - t0) / max(1, iters // 2)
-    results["heal_gbps"] = heal_bytes / results["heal_s"] / 1e9
+    results["heal_GBps"] = heal_bytes / results["heal_s"] / 1e9
 
     comm.barrier().wait(timeout=60.0)
     comm.shutdown()
     if rank == 0:
+        out_q.put(results)
+
+
+def _striped_rank_main(rank, world, port, mb, iters, gbps, rtt_ms, out_q):
+    """3-replica striped-heal measurement: ranks 0..world-2 are up-to-date
+    sources, the last rank is the healer.  Runs the SAME transfer with 1
+    source (exactly the legacy single-peer path) and with all sources
+    striped, so the speedup column isolates striping from topology."""
+    os.environ["TORCHFT_NET_GBPS"] = str(gbps)
+    os.environ["TORCHFT_NET_RTT_MS"] = str(rtt_ms)
+    from torchft_tpu.checkpointing.comm_transport import CommTransport
+    from torchft_tpu.communicator import TCPCommunicator
+
+    comm = TCPCommunicator(timeout_s=300.0)
+    comm.configure(
+        f"127.0.0.1:{port}/dcn_striped_{gbps}_{rtt_ms}",
+        replica_id=f"r{rank}",
+        rank=rank,
+        world_size=world,
+    )
+    n = mb * (1 << 20) // 4
+    # every source must hold the byte-identical checkpoint (same step, same
+    # weights) — that is the striping precondition, so seed independent of
+    # rank
+    rng = np.random.default_rng(42)
+    state = {
+        "params": rng.normal(size=n).astype(np.float32),
+        "opt": rng.normal(size=n // 2).astype(np.float32),
+    }
+    heal_bytes = sum(a.nbytes for a in state.values())
+    healer = world - 1
+    transport = CommTransport(comm, timeout=300.0)
+    heal_iters = max(1, iters // 2)
+    results = {}
+
+    for num_sources in (1, world - 1):
+        comm.barrier().wait(timeout=300.0)
+        t0 = time.perf_counter()
+        for i in range(heal_iters):
+            step = num_sources * 1000 + i  # disjoint tag space per config
+            if rank < num_sources:
+                transport.send_checkpoint_striped(
+                    [healer],
+                    step=step,
+                    state_dict=state,
+                    timeout=300.0,
+                    source_index=rank,
+                    num_sources=num_sources,
+                )
+            elif rank == healer:
+                got = transport.recv_checkpoint_striped(
+                    [(r, "<comm>") for r in range(num_sources)],
+                    step=step,
+                    timeout=300.0,
+                )
+                assert got["params"].nbytes == state["params"].nbytes
+        comm.barrier().wait(timeout=300.0)
+        if rank == healer:
+            dt = (time.perf_counter() - t0) / heal_iters
+            key = "1src" if num_sources == 1 else f"{num_sources}src"
+            results[f"heal_striped_{key}_s"] = dt
+            results[f"heal_striped_{key}_GBps"] = heal_bytes / dt / 1e9
+
+    comm.barrier().wait(timeout=60.0)
+    comm.shutdown()
+    if rank == healer:
         out_q.put(results)
 
 
@@ -128,9 +203,43 @@ def run_profile(name, gbps, rtt_ms, mb, iters):
         gbps=gbps,
         rtt_ms=rtt_ms,
         mb=mb,
-        f32_ring_algo_gbps=round(payload / res["f32_ring_s"] / 1e9, 3),
-        quant_ring_algo_gbps=round(payload / res["quant_ring_s"] / 1e9, 3),
+        f32_ring_algo_GBps=round(payload / res["f32_ring_s"] / 1e9, 3),
+        quant_ring_algo_GBps=round(payload / res["quant_ring_s"] / 1e9, 3),
         quant_speedup=round(res["f32_ring_s"] / res["quant_ring_s"], 3),
+    )
+    return {k: (round(v, 4) if isinstance(v, float) else v) for k, v in res.items()}
+
+
+def run_striped_profile(name, gbps, rtt_ms, mb, iters, world=3):
+    """Striped-heal rows for one profile: 1-source vs (world-1)-source heal
+    bandwidth in the same 3-replica topology."""
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer("127.0.0.1:0")
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_striped_rank_main,
+            args=(r, world, store.port, mb, iters, gbps, rtt_ms, out_q),
+        )
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        res = out_q.get(timeout=1200)
+        for p in procs:
+            p.join(timeout=120)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+        store.shutdown()
+    multi = f"{world - 1}src"
+    res["heal_striped_speedup"] = round(
+        res[f"heal_striped_1src_s"] / res[f"heal_striped_{multi}_s"], 3
     )
     return {k: (round(v, 4) if isinstance(v, float) else v) for k, v in res.items()}
 
@@ -142,11 +251,15 @@ def main():
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--md", action="store_true",
                     help="print a markdown table row block for RESULTS.md")
+    ap.add_argument("--no-striped", action="store_true",
+                    help="skip the 3-replica striped-heal phase")
     args = ap.parse_args()
 
     rows = []
     for name, gbps, rtt in PROFILES:
         row = run_profile(name, gbps, rtt, args.mb, args.iters)
+        if not args.no_striped:
+            row.update(run_striped_profile(name, gbps, rtt, args.mb, args.iters))
         print(json.dumps(row), flush=True)
         rows.append(row)
 
@@ -154,18 +267,26 @@ def main():
         print()
         print(
             "| profile | link | RTT | f32 ring | quant ring | quant speedup "
-            "| heal |"
+            "| heal | striped heal (2 src) |"
         )
-        print("|---|---|---|---|---|---|---|")
+        print("|---|---|---|---|---|---|---|---|")
         for r in rows:
             link = "—" if not r["gbps"] else f"{r['gbps']:g} Gb/s"
             rtt = "—" if not r["rtt_ms"] else f"{r['rtt_ms']:g} ms"
+            striped = "—"
+            if "heal_striped_2src_s" in r:
+                striped = (
+                    f"{r['heal_striped_2src_s']*1e3:.0f} ms "
+                    f"({r['heal_striped_2src_GBps']:.2f} GB/s, "
+                    f"**{r['heal_striped_speedup']}x** vs 1 src)"
+                )
             print(
                 f"| {r['profile']} | {link} | {rtt} "
-                f"| {r['f32_ring_s']*1e3:.0f} ms ({r['f32_ring_algo_gbps']} GB/s) "
-                f"| {r['quant_ring_s']*1e3:.0f} ms ({r['quant_ring_algo_gbps']} GB/s) "
+                f"| {r['f32_ring_s']*1e3:.0f} ms ({r['f32_ring_algo_GBps']} GB/s) "
+                f"| {r['quant_ring_s']*1e3:.0f} ms ({r['quant_ring_algo_GBps']} GB/s) "
                 f"| **{r['quant_speedup']}x** "
-                f"| {r['heal_s']*1e3:.0f} ms ({r['heal_gbps']:.2f} GB/s) |"
+                f"| {r['heal_s']*1e3:.0f} ms ({r['heal_GBps']:.2f} GB/s) "
+                f"| {striped} |"
             )
 
 

@@ -95,6 +95,13 @@ def _run_sweep(trials: int, budget_s: float) -> dict | None:
         return None
 
 
+def _fmt_tok(value) -> str:
+    """Thousands-grouped tokens/sec, or n/a — a malformed artifact missing a
+    rate key must not TypeError the ':,' format and (under --forever) kill
+    the whole watch loop."""
+    return f"{value:,.0f}" if isinstance(value, (int, float)) else "n/a"
+
+
 def _append_results_md(artifact: dict, json_name: str, stamp: str) -> None:
     single = artifact.get("single", {})
     lines = [
@@ -104,10 +111,10 @@ def _append_results_md(artifact: dict, json_name: str, stamp: str) -> None:
         f"- device: `{single.get('device_kind')}` "
         f"(tier `{single.get('tier')}`, remat `{single.get('remat')}`, "
         f"flash `{single.get('flash')}`)",
-        f"- fault-free: {single.get('faultfree_tokens_per_sec'):,} tok/s, "
+        f"- fault-free: {_fmt_tok(single.get('faultfree_tokens_per_sec'))} tok/s, "
         f"{single.get('model_tflops_per_sec')} model TFLOP/s, "
         f"**MFU {single.get('mfu')}**",
-        f"- FT stack ws=1: {single.get('ft_tokens_per_sec'):,} tok/s "
+        f"- FT stack ws=1: {_fmt_tok(single.get('ft_tokens_per_sec'))} tok/s "
         f"(ws1_ratio {single.get('ws1_ratio')}, mfu_ft {single.get('mfu_ft')})",
         f"- full JSON: `{json_name}`",
     ]
@@ -158,7 +165,12 @@ def main() -> None:
                 for path in (OUT_JSON, stamped):
                     with open(path, "w") as f:
                         json.dump(capture, f, indent=1)
-                _append_results_md(artifact, os.path.basename(stamped), stamp)
+                try:
+                    _append_results_md(
+                        artifact, os.path.basename(stamped), stamp
+                    )
+                except Exception as e:  # noqa: BLE001 — JSON already saved
+                    _log(f"RESULTS.md append failed (artifact kept): {e}")
                 single = artifact.get("single", {})
                 _log(
                     f"CAPTURED TPU artifact: mfu={single.get('mfu')} "

@@ -35,3 +35,34 @@ def test_joint_ft_spmd_quantized_outer_ring() -> None:
         quantize_outer=True,
     )
     assert facts["restarts"] == 0
+
+
+@pytest.mark.slow
+def test_joint_ft_spmd_striped_heal_with_source_kill() -> None:
+    """3 replicas, one killed: the rejoiner heals STRIPED from the 2
+    survivors while chaos kills one survivor's transport mid-transfer —
+    the heal must complete from the remaining source and all replicas
+    still converge bit-identically.
+
+    Marked slow: the full 3-replica drill under churn occasionally trips a
+    pre-existing per-group-commit divergence window (one replica's
+    collective errors while another's completes, and commit votes are per
+    replica group), independent of the striped heal itself — the
+    deterministic mid-heal-failover coverage lives in
+    tests/test_striped_heal.py."""
+    if len(jax.devices()) < 6:
+        pytest.skip("needs 6 (virtual) devices")
+    facts = joint_ft_spmd_drill(
+        n_devices=6,
+        num_replicas=3,
+        num_steps=6,
+        kill_replica=1,
+        kill_at_step=2,
+        heal_source_chaos=True,
+    )
+    assert facts["restarts"] == 1
+    assert facts["healed"]
+    assert facts["heal_source_killed"]
+    # the striped heal recorded its throughput facts
+    assert facts["heal_timings"].get("heal_num_sources") == 2.0
+    assert facts["heal_timings"].get("heal_bytes", 0) > 0

@@ -45,6 +45,7 @@ class Failure(enum.Enum):
     DEADLOCK = "deadlock"  # wedge mid-step; peers must evict via timeouts
     COMM_ABORT = "commabort"  # comms die under the replica (NIC analog)
     LIGHTHOUSE = "lighthouse"  # coordination plane dies + restarts
+    HEAL_SOURCE = "healsource"  # die mid-transfer while SERVING a heal
 
 
 @dataclass
@@ -53,6 +54,63 @@ class ChaosEvent:
     failure: Failure
     victim: Optional[str]
     detail: Dict[str, Any] = field(default_factory=dict)
+
+
+def arm_heal_source_kill(
+    transport: Any,
+    after_bytes: int = 1 << 20,
+    arm: Optional[threading.Event] = None,
+    striped_only: bool = False,
+) -> threading.Event:
+    """Arm a checkpoint transport to die after SERVING ~``after_bytes`` of
+    heal payload — the deterministic form of :attr:`Failure.HEAL_SOURCE`
+    (timing a SIGKILL against a transfer is racy; a byte-threshold trip
+    wire is not).  Returns an event set when the kill fires.
+
+    ``arm`` (optional) gates the trip wire: bytes served while it is unset
+    do not count and do not kill, so a drill can let the initial-sync heal
+    pass and only kill the source during the transfer under test.
+
+    ``striped_only`` restricts the kill to STRIPED serving (multi-source
+    chunk ranges, where a survivor can steal the dead source's chunks);
+    single-source transfers pass untouched — killing the only source is a
+    fatal scenario, not a failover drill.  The comm transport's trip wire
+    lives in its striped serve loop, so it is striped-only by nature.
+
+    Works on both checkpoint transports:
+
+    - :class:`~torchft_tpu.checkpointing.http_transport.HTTPTransport`:
+      the serving handler aborts mid-payload and the HTTP server shuts
+      down (further range requests are refused — the source looks dead).
+    - :class:`~torchft_tpu.checkpointing.comm_transport.CommTransport`:
+      the striped serve loop aborts its communicator after its sent-byte
+      counter passes the threshold.
+    """
+    fired = threading.Event()
+
+    if hasattr(transport, "chaos_die_after_bytes"):  # CommTransport
+        transport.chaos_die_after_bytes = after_bytes
+        transport.chaos_arm = arm
+        return transport.chaos_fired
+
+    if hasattr(transport, "chaos_striped_only"):
+        transport.chaos_striped_only = striped_only
+
+    served_while_armed = [0]
+    last_total = [0]
+
+    def _hook(total_bytes: int) -> bool:
+        delta, last_total[0] = total_bytes - last_total[0], total_bytes
+        if arm is not None and not arm.is_set():
+            return False
+        served_while_armed[0] += delta
+        if served_while_armed[0] >= after_bytes:
+            fired.set()
+            return True
+        return False
+
+    transport.chaos_serve_hook = _hook
+    return fired
 
 
 class ReplicaHandle(ABC):
@@ -87,6 +145,8 @@ class ThreadReplica(ReplicaHandle):
         self._obj = obj
 
     def supports(self, failure: Failure) -> bool:
+        if failure is Failure.HEAL_SOURCE:
+            return getattr(self._obj, "heal_transport", None) is not None
         return failure in (Failure.KILL, Failure.DEADLOCK, Failure.COMM_ABORT)
 
     def inject(self, failure: Failure, **kw: Any) -> None:
@@ -100,6 +160,15 @@ class ThreadReplica(ReplicaHandle):
             if comm is None:
                 raise RuntimeError(f"{self.name}: no live communicator yet")
             comm.abort(str(kw.get("reason", "chaos: injected comm failure")))
+        elif failure is Failure.HEAL_SOURCE:
+            transport = getattr(self._obj, "heal_transport", None)
+            if transport is None:
+                raise RuntimeError(f"{self.name}: no heal transport exposed")
+            arm_heal_source_kill(
+                transport,
+                after_bytes=int(kw.get("after_bytes", 1 << 20)),
+                arm=kw.get("arm"),
+            )
         else:
             raise ValueError(f"thread plane cannot inject {failure}")
 
@@ -132,10 +201,18 @@ class ProcessReplica(ReplicaHandle):
         self._progress_fn = progress_fn
 
     def supports(self, failure: Failure) -> bool:
-        return failure in (Failure.KILL, Failure.SEGFAULT, Failure.DEADLOCK)
+        return failure in (
+            Failure.KILL,
+            Failure.SEGFAULT,
+            Failure.DEADLOCK,
+            Failure.HEAL_SOURCE,
+        )
 
     def inject(self, failure: Failure, **kw: Any) -> None:
-        if failure is Failure.KILL:
+        if failure in (Failure.KILL, Failure.HEAL_SOURCE):
+            # process plane: a heal-source kill IS a hard kill — the caller
+            # times it against an in-flight heal (the thread plane gets the
+            # deterministic byte-threshold form instead)
             ok = self._supervisor.kill(self._gid, sig=signal.SIGKILL)
         elif failure is Failure.SEGFAULT:
             ok = self._supervisor.kill(self._gid, sig=signal.SIGSEGV)

@@ -22,15 +22,73 @@ layout, not the sender's, governs where shards land.
 
 from __future__ import annotations
 
+import hashlib
 import io
+import os
 import pickle
 import struct
-from dataclasses import dataclass
-from typing import Any, BinaryIO, List, Tuple
+import threading
+from dataclasses import dataclass, field
+from typing import Any, BinaryIO, List, Optional, Tuple
 
 import numpy as np
 
 MAGIC = b"TFTC\x01"
+
+# Target striped-heal chunk size.  Smaller chunks stripe/steal at finer
+# granularity (better load balance, cheaper mid-heal failover) at the cost
+# of more requests/frames; the default keeps per-chunk overhead <1% on
+# multi-MB transfers.
+HEAL_CHUNK_MB_ENV = "TORCHFT_HEAL_CHUNK_MB"
+DEFAULT_HEAL_CHUNK_BYTES = 4 << 20
+
+
+def heal_chunk_bytes() -> int:
+    mb = os.environ.get(HEAL_CHUNK_MB_ENV)
+    if mb:
+        return max(1 << 16, int(float(mb) * (1 << 20)))
+    return DEFAULT_HEAL_CHUNK_BYTES
+
+
+def chunk_ranges(
+    header_len: int, leaf_nbytes: List[int], target_bytes: int
+) -> List[Tuple[int, int]]:
+    """Deterministic chunk boundaries over the serialized stream.
+
+    The stream is a sequence of units — the header, then one (8-byte length
+    + payload) per array.  Whole units pack greedily up to ``target_bytes``;
+    a unit larger than the target splits at target granularity from its own
+    start.  Boundaries are therefore a pure function of the tree structure
+    and leaf sizes, so every peer holding the same state at the same step
+    produces the SAME ranges over byte-identical content — the property that
+    lets a healer assemble one buffer from many peers' streams.
+    """
+    target = max(1, int(target_bytes))
+    units = [header_len] + [8 + n for n in leaf_nbytes]
+    chunks: List[Tuple[int, int]] = []
+    off = 0
+    cur_start = 0
+    cur = 0  # bytes accumulated in the open chunk
+    for unit in units:
+        if unit > target:
+            if cur:
+                chunks.append((cur_start, off))
+            start = off
+            while start < off + unit:
+                stop = min(off + unit, start + target)
+                chunks.append((start, stop))
+                start = stop
+            off += unit
+            cur_start, cur = off, 0
+            continue
+        off += unit
+        cur += unit
+        if cur >= target:
+            chunks.append((cur_start, off))
+            cur_start, cur = off, 0
+    if cur:
+        chunks.append((cur_start, off))
+    return chunks
 
 
 def as_byte_view(arr: np.ndarray) -> memoryview:
@@ -212,6 +270,34 @@ class PytreePlan:
     leaves: List[Any]
     leaf_nbytes: List[int]
     total_len: int
+    # one-leaf D2H memo: several striped range requests cut the same large
+    # leaf, and each write_range would otherwise device_get the whole leaf
+    # again; the memo holds the most recent materialization
+    _memo: Optional[Tuple[int, np.ndarray]] = None
+    _memo_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def header_digest(self) -> str:
+        """Digest of the byte prefix (magic + skeleton + count).  Striped
+        healers compare it across sources: peers serving the same step must
+        agree byte-for-byte or assembling one buffer from many streams would
+        silently corrupt."""
+        return hashlib.sha256(self.header).hexdigest()
+
+    def chunk_ranges(
+        self, target_bytes: Optional[int] = None
+    ) -> List[Tuple[int, int]]:
+        return chunk_ranges(
+            len(self.header), self.leaf_nbytes, target_bytes or heal_chunk_bytes()
+        )
+
+    def _materialize(self, index: int) -> np.ndarray:
+        with self._memo_lock:
+            if self._memo is not None and self._memo[0] == index:
+                return self._memo[1]
+        arr = materialize_leaf(self.leaves[index])
+        with self._memo_lock:
+            self._memo = (index, arr)
+        return arr
 
     def write_range(self, start: int, stop: int, stream: BinaryIO) -> None:
         """Stream bytes [start, stop) of the serialized form, materializing
@@ -227,7 +313,7 @@ class PytreePlan:
             off += n
 
         _emit(self.header)
-        for leaf, nbytes in zip(self.leaves, self.leaf_nbytes):
+        for i, nbytes in enumerate(self.leaf_nbytes):
             if off + 8 + nbytes <= start:
                 off += 8 + nbytes  # fully before the range: skip cheaply
                 continue
@@ -237,7 +323,7 @@ class PytreePlan:
             if off + nbytes <= start:
                 off += nbytes
                 continue
-            _emit(as_byte_view(materialize_leaf(leaf)))
+            _emit(as_byte_view(self._materialize(i)))
 
 
 def _snapshot_leaf(leaf: Any) -> Any:
@@ -369,6 +455,66 @@ def load_pytree(stream: BinaryIO, leaf_hook: Any = None) -> Any:
         arrays.append(arr if leaf_hook is None else leaf_hook(arr))
 
     return _restore_arrays(skeleton, arrays)
+
+
+def array_chunk_ranges(
+    nbytes_list: List[int], target_bytes: int
+) -> List[Tuple[int, int, int]]:
+    """Chunk index at RAW array-payload granularity: ``(array_index, start,
+    stop)`` byte ranges within each array's buffer, each at most
+    ``target_bytes`` long.  Used by the comm-transport striped heal, whose
+    chunks land directly in the final (preallocated) array buffers — no
+    serialized-stream reassembly pass.  Deterministic given identical array
+    metas, which same-step peers share by construction."""
+    target = max(1, int(target_bytes))
+    out: List[Tuple[int, int, int]] = []
+    for ai, n in enumerate(nbytes_list):
+        start = 0
+        while start < n:
+            stop = min(n, start + target)
+            out.append((ai, start, stop))
+            start = stop
+    return out
+
+
+def balanced_shares(sizes: List[int], num_shares: int) -> List[List[int]]:
+    """Deterministic byte-balanced assignment of chunk indices to shares
+    (greedy longest-first onto the least-loaded share, ties to the lowest
+    index).  Plain ``idx % num_shares`` can hand one source most of the
+    bytes when chunk sizes are uneven — the heal then runs at the slowest
+    share's pace.  Every peer computes the SAME assignment from the same
+    chunk table, which is what lets senders and the healer agree without a
+    negotiation round-trip."""
+    order = sorted(range(len(sizes)), key=lambda i: (-sizes[i], i))
+    loads = [0] * num_shares
+    shares: List[List[int]] = [[] for _ in range(num_shares)]
+    for i in order:
+        target = min(range(num_shares), key=lambda s: (loads[s], s))
+        shares[target].append(i)
+        loads[target] += sizes[i]
+    return [sorted(s) for s in shares]
+
+
+class ViewReader:
+    """Minimal read/readinto stream over a memoryview (no BytesIO copy) —
+    the zero-copy way to ``load_pytree`` an assembled striped-heal buffer."""
+
+    def __init__(self, view: memoryview) -> None:
+        self._view = view
+        self._off = 0
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = len(self._view) - self._off
+        out = bytes(self._view[self._off : self._off + n])
+        self._off += len(out)
+        return out
+
+    def readinto(self, out) -> int:
+        n = min(len(out), len(self._view) - self._off)
+        out[:n] = self._view[self._off : self._off + n]
+        self._off += n
+        return n
 
 
 def dumps_pytree(state: Any) -> bytes:

@@ -90,6 +90,13 @@ class CommunicatorAborted(CommunicatorError):
     pass
 
 
+class PeerGoneError(CommunicatorError):
+    """A peer's connection is DEAD (closed socket / failed send) — a
+    fail-stop condition scoped to that pair.  Distinct from protocol errors
+    (tag/size mismatch) where the socket survives with a desynchronized
+    stream and the whole epoch must be poisoned."""
+
+
 class Communicator(ABC):
     """Abstract reconfigurable communicator (``process_group.py:131-399``)."""
 
@@ -148,6 +155,25 @@ class Communicator(ABC):
     def recv_bytes_into(self, src: int, out: np.ndarray, tag: int = 0) -> Work:
         """Zero-copy variant: receive one frame directly into ``out`` (a
         contiguous writable array); the Work's value is the payload size."""
+        raise NotImplementedError
+
+    def heal_drain(
+        self,
+        chunk_views: List[memoryview],
+        expected: Dict[int, List[int]],
+        orphans: List[int],
+        chunk_tag: Callable[[int], int],
+        ctrl_tag: int,
+        make_need: Callable[[List[int]], bytes],
+        done_blob: bytes,
+        timeout_s: Optional[float] = None,
+    ) -> Work:
+        """Striped-heal receive: concurrently drain disjoint chunk frames
+        from many source peers straight into ``chunk_views`` (see
+        :meth:`TCPCommunicator.heal_drain`).  ``timeout_s`` bounds the whole
+        drain (it may legitimately outlast the per-collective op timeout).
+        Tiers without it raise, and the checkpoint transport falls back to
+        the single-source heal."""
         raise NotImplementedError
 
     def reduce_scatter(
@@ -363,7 +389,7 @@ class _TcpMesh:
                 except BlockingIOError:
                     continue
                 if n == 0:
-                    raise CommunicatorError(f"connection to rank {src} closed")
+                    raise PeerGoneError(f"connection to rank {src} closed")
                 return n
 
         hdr = bytearray(_HDR.size)
@@ -408,7 +434,7 @@ class _TcpMesh:
                 except BlockingIOError:
                     continue
                 if n == 0:
-                    raise CommunicatorError(f"connection to rank {src} closed")
+                    raise PeerGoneError(f"connection to rank {src} closed")
                 return n
 
         hdr = bytearray(_HDR.size)
@@ -497,7 +523,7 @@ class _TcpMesh:
                 except BlockingIOError:
                     pass
                 except OSError as e:
-                    raise CommunicatorError(f"send to rank {peer} failed: {e}") from e
+                    raise PeerGoneError(f"send to rank {peer} failed: {e}") from e
                 if not bufs:
                     del send_state[peer]
 
@@ -510,7 +536,7 @@ class _TcpMesh:
                     if len(st["hdr"]) < _HDR.size:
                         chunk = sock.recv(_HDR.size - len(st["hdr"]))
                         if not chunk:
-                            raise CommunicatorError(
+                            raise PeerGoneError(
                                 f"connection to rank {peer} closed"
                             )
                         st["hdr"] += chunk
@@ -529,7 +555,7 @@ class _TcpMesh:
                     elif st["off"] < len(st["view"]):
                         n = sock.recv_into(st["view"][st["off"] :])
                         if n == 0:
-                            raise CommunicatorError(
+                            raise PeerGoneError(
                                 f"connection to rank {peer} closed"
                             )
                         st["off"] += n
@@ -544,6 +570,228 @@ class _TcpMesh:
                 # socket writable but the pacer denied bytes — select would
                 # return immediately and spin the op thread hot
                 time.sleep(0.0005)
+
+    def striped_drain(
+        self,
+        chunk_views: List[memoryview],
+        expected: Dict[int, List[int]],
+        orphans: List[int],
+        chunk_tag: Callable[[int], int],
+        ctrl_tag: int,
+        make_need: Callable[[List[int]], bytes],
+        done_blob: bytes,
+        deadline: float,
+    ) -> Dict[str, object]:
+        """Concurrently drain disjoint chunk frames from MANY peers into one
+        assembly buffer — the striped-heal receive path.
+
+        Per-chunk recv ops would serialize on the op thread and cap a
+        multi-source heal at one link's bandwidth; this runs as ONE op,
+        select-driven across every source socket at once (the same duplex
+        pattern as :meth:`exchange`), so P paced senders aggregate to ~P
+        links.
+
+        ``chunk_views`` maps each chunk index to the writable buffer slice
+        its bytes land in (usually a range of a preallocated final array —
+        the heal has no reassembly pass).  ``expected`` maps each live
+        source rank to the ORDERED chunk indices it will push
+        spontaneously; ``orphans`` are chunks whose owner was already dead
+        at start.  A source that errors mid-drain
+        has its outstanding chunks (including the partially-received one —
+        chunk content is byte-identical across peers, so a re-fetch simply
+        overwrites) re-requested from the least-loaded survivor via a
+        ``make_need`` control frame on the dst→src direction.  Survivors
+        get ``done_blob`` when everything landed.  Raises only when ALL
+        sources are dead with chunks outstanding (or on deadline); returns
+        ``{"per_source": {rank: bytes}, "dead": {rank: exc}, "stolen": n}``.
+        """
+        needed = set(orphans)
+        for lst in expected.values():
+            needed.update(lst)
+        queues: Dict[int, List[int]] = {p: list(lst) for p, lst in expected.items()}
+        pending_ctrl: Dict[int, List[memoryview]] = {p: [] for p in queues}
+        frame_gates: Dict[int, float] = {}
+        recv_st: Dict[int, Optional[dict]] = {p: None for p in queues}
+        received: set = set()
+        per_source: Dict[int, int] = {p: 0 for p in queues}
+        dead: Dict[int, BaseException] = {}
+        stolen = [0]
+        orphan_list = list(orphans)
+
+        def _enqueue_ctrl(p: int, payload: bytes) -> None:
+            frame = _HDR.pack(len(payload), ctrl_tag) + payload
+            pending_ctrl[p].append(memoryview(frame))
+
+        def _assign_orphans() -> None:
+            if not orphan_list:
+                return
+            alive = [p for p in queues if p not in dead]
+            if not alive:
+                return
+            target = min(alive, key=lambda p: len(queues[p]))
+            batch = sorted(orphan_list)
+            orphan_list.clear()
+            stolen[0] += len(batch)
+            _enqueue_ctrl(target, make_need(batch))
+            queues[target].extend(batch)
+
+        def _mark_dead(p: int, e: BaseException) -> None:
+            dead[p] = e
+            orphan_list.extend(i for i in queues[p] if i not in received)
+            queues[p] = []
+            recv_st[p] = None
+            pending_ctrl[p] = []
+            if not isinstance(e, PeerGoneError):
+                # protocol error (tag/size mismatch): the pair's stream is
+                # desynchronized but the socket is alive — close it so later
+                # ops fail cleanly instead of misparsing garbage frames
+                try:
+                    self.peers[p].close()
+                except OSError:
+                    pass
+            logger.warning(
+                "striped drain: source rank %d died (%s); reassigning", p, e
+            )
+            _assign_orphans()
+
+        def _flush_writes(wlist_socks: List[socket.socket]) -> bool:
+            paced = False
+            for sock in wlist_socks:
+                p = next(q for q, s in self.peers.items() if s is sock)
+                bufs = pending_ctrl.get(p)
+                if not bufs or p in dead:
+                    continue
+                if self._emu is not None:
+                    gate = frame_gates.setdefault(p, self._emu.frame_gate())
+                    if time.monotonic() < gate:
+                        paced = True
+                        continue
+                try:
+                    while bufs:
+                        chunk_b = bufs[0]
+                        if self._emu is not None and len(chunk_b) > 0:
+                            allowed = self._emu.allow(len(chunk_b))
+                            if allowed <= 0:
+                                paced = True
+                                break
+                            chunk_b = chunk_b[:allowed]
+                        sent = sock.send(chunk_b)
+                        if self._emu is not None:
+                            self._emu.consume(sent)
+                        if sent == len(bufs[0]):
+                            bufs.pop(0)
+                            frame_gates.pop(p, None)
+                        else:
+                            bufs[0] = bufs[0][sent:]
+                            break
+                except BlockingIOError:
+                    pass
+                except OSError as e:
+                    _mark_dead(p, PeerGoneError(f"send to rank {p} failed: {e}"))
+            return paced
+
+        _assign_orphans()
+
+        while received != needed:
+            self._check_abort()
+            if time.monotonic() > deadline:
+                raise TimeoutError("striped drain timed out")
+            alive = [p for p in queues if p not in dead]
+            if not alive:
+                first = next(iter(dead.values()))
+                raise CommunicatorError(
+                    f"all heal sources died with "
+                    f"{len(needed) - len(received)} chunks outstanding: {first}"
+                )
+            rlist = [self.peers[p] for p in alive if queues[p]]
+            wlist = [self.peers[p] for p in alive if pending_ctrl[p]]
+            if not rlist and not wlist:
+                time.sleep(0.001)  # only orphan bookkeeping left; rare
+                continue
+            readable, writable, _ = select.select(rlist, wlist, [], 0.1)
+            paced_block = _flush_writes(writable)
+            for sock in readable:
+                p = next(q for q, s in self.peers.items() if s is sock)
+                # drain the socket fully per readiness event (frames arrive
+                # back to back): one recv per select round would double the
+                # syscall count and cap the aggregate drain rate
+                while p not in dead and queues[p]:
+                    st = recv_st[p]
+                    if st is None:
+                        st = recv_st[p] = {"hdr": bytearray(), "off": 0}
+                    try:
+                        if len(st["hdr"]) < _HDR.size:
+                            chunk_b = sock.recv(_HDR.size - len(st["hdr"]))
+                            if not chunk_b:
+                                raise PeerGoneError(
+                                    f"connection to rank {p} closed"
+                                )
+                            st["hdr"] += chunk_b
+                            if len(st["hdr"]) == _HDR.size:
+                                nbytes, tag = _HDR.unpack(bytes(st["hdr"]))
+                                idx = queues[p][0]
+                                view = chunk_views[idx]
+                                if tag != chunk_tag(idx):
+                                    raise CommunicatorError(
+                                        f"tag mismatch from rank {p}: got "
+                                        f"{tag}, want {chunk_tag(idx)} "
+                                        f"(chunk {idx})"
+                                    )
+                                if nbytes != len(view):
+                                    raise CommunicatorError(
+                                        f"size mismatch from rank {p}: got "
+                                        f"{nbytes}, want {len(view)} "
+                                        f"(chunk {idx})"
+                                    )
+                                st["view"] = view
+                        elif st["off"] < len(st["view"]):
+                            n = sock.recv_into(st["view"][st["off"] :])
+                            if n == 0:
+                                raise PeerGoneError(
+                                    f"connection to rank {p} closed"
+                                )
+                            st["off"] += n
+                    except BlockingIOError:
+                        break
+                    except (OSError, CommunicatorError) as e:
+                        _mark_dead(
+                            p,
+                            e
+                            if isinstance(e, CommunicatorError)
+                            else CommunicatorError(str(e)),
+                        )
+                        break
+                    if len(st["hdr"]) == _HDR.size and st["off"] == len(
+                        st.get("view", b"")
+                    ):
+                        idx = queues[p].pop(0)
+                        received.add(idx)
+                        per_source[p] += len(st["view"])
+                        recv_st[p] = None
+            if paced_block:
+                time.sleep(0.0005)
+
+        # release surviving senders from their steal-service loops
+        # (best-effort, bounded: a wedged survivor must not park the heal)
+        for p in [p for p in queues if p not in dead]:
+            _enqueue_ctrl(p, done_blob)
+        flush_deadline = min(deadline, time.monotonic() + 5.0)
+        while any(
+            pending_ctrl[p] for p in queues if p not in dead
+        ) and time.monotonic() < flush_deadline:
+            self._check_abort()
+            wlist = [
+                self.peers[p]
+                for p in queues
+                if p not in dead and pending_ctrl[p]
+            ]
+            if not wlist:
+                break
+            _, writable, _ = select.select([], wlist, [], 0.1)
+            if _flush_writes(writable):
+                time.sleep(0.0005)
+
+        return {"per_source": per_source, "dead": dead, "stolen": stolen[0]}
 
 
 def _recv_exact(
@@ -583,7 +831,7 @@ class TCPCommunicator(Communicator):
         self._world_size = 1
         self._quorum_id = -1
         self._errored: Optional[Exception] = None
-        self._ops: "queue.Queue[Optional[Tuple[Callable[[], object], Future]]]" = (
+        self._ops: "queue.Queue[Optional[Tuple[Callable[[], object], Future, bool, Optional[float]]]]" = (
             queue.Queue()
         )
         self._op_thread: Optional[threading.Thread] = None
@@ -705,20 +953,21 @@ class TCPCommunicator(Communicator):
 
     def _run_ops(
         self,
-        ops: "queue.Queue[Optional[Tuple[Callable[[], object], Future]]]",
+        ops: "queue.Queue[Optional[Tuple[Callable[[], object], Future, bool, Optional[float]]]]",
         epoch: int,
     ) -> None:
         while True:
             item = ops.get()
             if item is None:
                 return
-            fn, fut = item
+            fn, fut, peer_fail_stop, op_timeout_s = item
             if not fut.set_running_or_notify_cancel():
                 continue
             # Userspace per-op watchdog: a wedged collective aborts the
             # communicator (unblocking the socket IO) instead of hanging the
-            # train loop or killing the process.
-            timeout_s = self._timeout_s
+            # train loop or killing the process.  A long-running op (a
+            # striped heal drain) may carry its own bound.
+            timeout_s = op_timeout_s if op_timeout_s is not None else self._timeout_s
             handle: TimerHandle = schedule_timeout(
                 timeout_s,
                 lambda: self._abort_if_epoch(
@@ -728,18 +977,36 @@ class TCPCommunicator(Communicator):
             try:
                 result = fn()
             except BaseException as e:  # noqa: BLE001
-                with self._lock:
-                    if self._epoch == epoch and self._errored is None:
-                        self._errored = (
-                            e if isinstance(e, Exception) else RuntimeError(str(e))
-                        )
+                # A fail-stop PEER death on a point-to-point byte op (dead
+                # socket — the striped-heal failover case) stays scoped to
+                # that op: the pair's socket is permanently closed, other
+                # pairs' streams are untouched, so poisoning the epoch would
+                # only turn a survivable source loss into a failed heal.
+                # Everything else still latches: collective failures leave
+                # OTHER pairs mid-frame, protocol errors (tag/size mismatch)
+                # leave THIS pair's stream desynchronized on a live socket,
+                # and op timeouts already abort via the watchdog above.
+                peer_scoped = peer_fail_stop and isinstance(e, PeerGoneError)
+                if not peer_scoped:
+                    with self._lock:
+                        if self._epoch == epoch and self._errored is None:
+                            self._errored = (
+                                e
+                                if isinstance(e, Exception)
+                                else RuntimeError(str(e))
+                            )
                 fut.set_exception(e)
             else:
                 fut.set_result(result)
             finally:
                 handle.cancel()
 
-    def _submit(self, make_fn: Callable[["_CommCtx"], Callable[[], object]]) -> Work:
+    def _submit(
+        self,
+        make_fn: Callable[["_CommCtx"], Callable[[], object]],
+        peer_fail_stop: bool = False,
+        op_timeout_s: Optional[float] = None,
+    ) -> Work:
         # Ops capture an epoch-pinned snapshot of (mesh, rank, ws) so an op
         # drained late from a superseded queue can never touch the sockets of
         # a newer epoch.
@@ -758,10 +1025,12 @@ class TCPCommunicator(Communicator):
                 mesh=self._mesh,
                 rank=self._rank,
                 world_size=self._world_size,
-                timeout_s=self._timeout_s,
+                timeout_s=(
+                    op_timeout_s if op_timeout_s is not None else self._timeout_s
+                ),
             )
             fut = Future()
-            self._ops.put((make_fn(ctx), fut))
+            self._ops.put((make_fn(ctx), fut, peer_fail_stop, op_timeout_s))
             return Work(fut)
 
     # -- collectives ---------------------------------------------------------
@@ -844,7 +1113,7 @@ class TCPCommunicator(Communicator):
 
             return _run
 
-        return self._submit(_make)
+        return self._submit(_make, peer_fail_stop=True)
 
     def recv_bytes(self, src: int, tag: int = 0) -> Work:
         """Receive one frame from ``src``; the size rides in the frame header
@@ -857,7 +1126,7 @@ class TCPCommunicator(Communicator):
 
             return _run
 
-        return self._submit(_make)
+        return self._submit(_make, peer_fail_stop=True)
 
     def recv_bytes_into(self, src: int, out: np.ndarray, tag: int = 0) -> Work:
         view = _bytes_view(out)
@@ -871,7 +1140,46 @@ class TCPCommunicator(Communicator):
 
             return _run
 
-        return self._submit(_make)
+        return self._submit(_make, peer_fail_stop=True)
+
+    def heal_drain(
+        self,
+        chunk_views: List[memoryview],
+        expected: Dict[int, List[int]],
+        orphans: List[int],
+        chunk_tag: Callable[[int], int],
+        ctrl_tag: int,
+        make_need: Callable[[List[int]], bytes],
+        done_blob: bytes,
+        timeout_s: Optional[float] = None,
+    ) -> Work:
+        """Striped-heal receive: concurrently drain disjoint chunk frames
+        from every source peer straight into ``chunk_views`` as ONE op (see
+        :meth:`_TcpMesh.striped_drain`) — per-chunk recv ops would
+        serialize on the op thread and cap the heal at a single link's
+        bandwidth.  ``timeout_s`` (default: the communicator op timeout)
+        bounds the whole drain, watchdog included — a heal given a longer
+        deadline than one collective must not be aborted mid-transfer."""
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                for p in expected:
+                    ctx.require_peer(p)
+                assert ctx.mesh is not None
+                return ctx.mesh.striped_drain(
+                    chunk_views,
+                    expected,
+                    orphans,
+                    chunk_tag,
+                    ctrl_tag,
+                    make_need,
+                    done_blob,
+                    ctx.deadline(),
+                )
+
+            return _run
+
+        return self._submit(_make, peer_fail_stop=True, op_timeout_s=timeout_s)
 
     def _all_exchange(
         self,
@@ -1246,6 +1554,9 @@ class FakeCommunicatorWrapper(Communicator):
     def recv_bytes_into(self, src: int, out, tag: int = 0) -> Work:
         return self._wrap(self._comm.recv_bytes_into(src, out, tag))
 
+    def heal_drain(self, *args, **kwargs) -> Work:
+        return self._wrap(self._comm.heal_drain(*args, **kwargs))
+
     def alltoall(self, chunks, tag: int = 0) -> Work:
         return self._wrap(self._comm.alltoall(chunks, tag))
 
@@ -1311,6 +1622,9 @@ class ManagedCommunicator(Communicator):
 
     def recv_bytes_into(self, src: int, out, tag: int = 0) -> Work:
         return self._manager._comm.recv_bytes_into(src, out, tag)
+
+    def heal_drain(self, *args, **kwargs) -> Work:
+        return self._manager._comm.heal_drain(*args, **kwargs)
 
     def barrier(self) -> Work:
         return self._manager._comm.barrier()

@@ -48,19 +48,33 @@ def joint_ft_spmd_drill(
     step_time_s: float = 0.05,
     timeout_s: float = 30.0,
     quantize_outer: bool = False,
+    heal_source_chaos: bool = False,
 ) -> Dict[str, Any]:
     """Run the drill and return summary facts (asserts internally).
 
-    Returns ``{"restarts": int, "healed": bool, "final_states": [...]}``.
+    ``heal_source_chaos`` (requires ``num_replicas >= 3`` so the rejoiner
+    has 2+ striped heal sources) arms one SURVIVOR's checkpoint transport
+    to die mid-transfer while serving the rejoiner's heal — the heal must
+    still complete bit-identically from the remaining source(s).
+
+    Returns ``{"restarts": int, "healed": bool, "final_states": [...],
+    "heal_source_killed": bool, "heal_timings": {...}}``.
     """
     import optax
 
+    from torchft_tpu.chaos import arm_heal_source_kill
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
     from torchft_tpu.communicator import TCPCommunicator
     from torchft_tpu.lighthouse import LighthouseServer
     from torchft_tpu.manager import Manager
     from torchft_tpu.models.llama import Llama, llama_debug
     from torchft_tpu.parallel.hsdp import HSDPTrainer, fsdp_shardings
     from torchft_tpu.parallel.mesh import make_mesh
+
+    if heal_source_chaos:
+        assert kill_replica is not None and num_replicas >= 3, (
+            "heal_source_chaos needs a kill and >= 3 replicas (2+ sources)"
+        )
 
     devices = jax.devices()
     per_replica = n_devices // num_replicas
@@ -74,12 +88,16 @@ def joint_ft_spmd_drill(
     lighthouse = LighthouseServer(
         bind="127.0.0.1:0",
         min_replicas=1,
-        join_timeout_ms=200,
+        # the chaos drill needs the rejoin quorum to include EVERY survivor
+        # (2+ striped sources), so give healthy stragglers a wider join
+        # window before a partial quorum is issued
+        join_timeout_ms=1500 if heal_source_chaos else 200,
         quorum_tick_ms=20,
         heartbeat_timeout_ms=1000,
     )
     restarts = [0]
     healed = [False]
+    heal_timings: Dict[str, float] = {}
     zombies: List[Manager] = []
     # rendezvous gate: the survivor must not burn through its remaining
     # steps before the killed replica's re-init (recompile included) gets a
@@ -87,6 +105,13 @@ def joint_ft_spmd_drill(
     rejoined = threading.Event()
     if kill_replica is None:
         rejoined.set()
+    # mid-heal source kill: one survivor's transport dies after serving a
+    # few chunks of the rejoiner's heal (armed on the rejoin gate so the
+    # step-0 init-sync transfer doesn't trip it)
+    chaos_source = (
+        (kill_replica + 1) % num_replicas if heal_source_chaos else None
+    )
+    chaos_fired = threading.Event()
 
     def _host_state(tree: Any) -> Dict[str, np.ndarray]:
         out = {}
@@ -103,6 +128,29 @@ def joint_ft_spmd_drill(
         model = Llama(llama_debug(), mesh=mesh)
         first_life = True
         while True:
+            transport = None
+            if heal_source_chaos:
+                # tiny chunks on EVERY source (the healer adopts whichever
+                # source's index answers first — a lone small-chunk source
+                # would be moot) so the kill lands with plenty of the
+                # transfer left to steal
+                transport = HTTPTransport(
+                    timeout=timeout_s, heal_chunk_bytes=1 << 14
+                )
+            if idx == chaos_source:
+                fired = arm_heal_source_kill(
+                    transport,
+                    after_bytes=1 << 14,
+                    arm=rejoined,
+                    striped_only=True,
+                )
+
+                def _relay(f=fired) -> None:
+                    f.wait(timeout=120.0)
+                    if f.is_set():
+                        chaos_fired.set()
+
+                threading.Thread(target=_relay, daemon=True).start()
             manager = Manager(
                 comm=TCPCommunicator(timeout_s=timeout_s),
                 load_state_dict=None,
@@ -113,6 +161,7 @@ def joint_ft_spmd_drill(
                 timeout=timeout_s,
                 quorum_timeout=timeout_s,
                 connect_timeout=timeout_s,
+                checkpoint_transport=transport,
             )
             zombies.append(manager)
             trainer = HSDPTrainer(
@@ -135,14 +184,38 @@ def joint_ft_spmd_drill(
             try:
                 import time as _time
 
+                if not first_life and heal_source_chaos:
+                    # the chaos scenario NEEDS >= 2 striped sources: wait
+                    # until every survivor is a same-step participant of the
+                    # current quorum before rejoining (a survivor still
+                    # catching up from startup churn would leave a single
+                    # source, and the kill would fail the whole heal)
+                    gate_deadline = _time.time() + 60.0
+                    while _time.time() < gate_deadline:
+                        parts = lighthouse._status()["participants"]
+                        others = [
+                            p
+                            for p in parts
+                            if not p["replica_id"].startswith(f"drill_{idx}")
+                        ]
+                        if (
+                            len(others) >= num_replicas - 1
+                            and len({p["step"] for p in others}) == 1
+                        ):
+                            break
+                        _time.sleep(0.1)
                 if not first_life:
                     rejoined.set()  # back up, about to request quorums
                 while manager.current_step() < num_steps:
                     if (
                         first_life
                         and idx == kill_replica
-                        and manager.current_step() == kill_at_step
+                        and manager.current_step() >= kill_at_step
                     ):
+                        # >= not ==: a startup heal can JUMP the victim past
+                        # the exact step (it adopts max_step), which would
+                        # skip the kill and park the survivors on the
+                        # rejoin gate forever
                         raise _Die()
                     if (
                         idx != kill_replica
@@ -155,6 +228,20 @@ def joint_ft_spmd_drill(
                     assert np.isfinite(loss), f"non-finite loss {loss}"
                 if not first_life:
                     healed[0] = True
+                    # heal-path throughput facts: read the transport's
+                    # persistent metrics, NOT last_quorum_timings — every
+                    # later step's quorum rebinds that dict, so the healing
+                    # round's entries survive only by luck
+                    m = getattr(
+                        manager._checkpoint_transport, "last_heal_metrics", None
+                    )
+                    if m is not None:
+                        heal_timings.update(
+                            heal_num_sources=float(m.num_sources),
+                            heal_bytes=float(m.bytes_total),
+                            heal_bytes_per_sec=m.bytes_per_sec,
+                            heal_stolen_chunks=float(m.stolen_chunks),
+                        )
                 return _host_state(trainer.holder["params"])
             except _Die:
                 restarts[0] += 1
@@ -190,8 +277,12 @@ def joint_ft_spmd_drill(
     if kill_replica is not None:
         assert restarts[0] >= 1, "kill was never injected"
         assert healed[0], "restarted replica never completed a healed run"
+    if heal_source_chaos:
+        assert chaos_fired.is_set(), "heal-source kill never fired"
     return {
         "restarts": restarts[0],
         "healed": healed[0],
         "final_states": states,
+        "heal_source_killed": chaos_fired.is_set(),
+        "heal_timings": dict(heal_timings),
     }

@@ -437,12 +437,24 @@ class LighthouseServer:
             now = time.monotonic()
             _, reason = quorum_compute(now, self._state, self._cfg)
             prev = self._state.prev_quorum
+            max_step = (
+                max((p.step for p in prev.participants), default=-1) if prev else -1
+            )
+            # heal-path facts: who is behind (will recover on its next
+            # quorum) and how many up-to-date peers can serve a striped heal
+            lagging = [
+                p.replica_id
+                for p in (prev.participants if prev else [])
+                if p.step < max_step
+            ]
             return {
                 "quorum_id": self._state.quorum_id,
                 "quorum_status": reason,
-                "max_step": max((p.step for p in prev.participants), default=-1)
-                if prev
-                else -1,
+                "max_step": max_step,
+                "lagging_replicas": lagging,
+                "num_heal_sources": (
+                    len(prev.participants) - len(lagging) if prev else 0
+                ),
                 "num_participants": len(prev.participants) if prev else -1,
                 "participants": [
                     {
@@ -535,7 +547,9 @@ class LighthouseServer:
             "display:inline-block;padding:1em;margin:.5em}</style></head><body>"
             f"<h1>torchft_tpu lighthouse</h1>"
             f"<p>quorum_id={s['quorum_id']} · status: {html.escape(s['quorum_status'])}</p>"
-            f"<p>max_step={s['max_step']} · participants={s['num_participants']}</p>"
+            f"<p>max_step={s['max_step']} · participants={s['num_participants']}"
+            f" · heal sources={s['num_heal_sources']}"
+            f" · lagging={html.escape(', '.join(s['lagging_replicas']) or 'none')}</p>"
             f"{cards}<h2>heartbeats</h2><ul>{beats}</ul></body></html>"
         )
 

@@ -58,6 +58,16 @@ TIMEOUT_SEC_ENV = "TORCHFT_TIMEOUT_SEC"
 QUORUM_TIMEOUT_SEC_ENV = "TORCHFT_QUORUM_TIMEOUT_SEC"
 CONNECT_TIMEOUT_SEC_ENV = "TORCHFT_CONNECT_TIMEOUT_SEC"
 QUORUM_RETRIES_ENV = "TORCHFT_QUORUM_RETRIES"
+# Striped healing: fetch the recovery checkpoint as disjoint chunk ranges
+# from EVERY up-to-date peer instead of one round-robin source (on by
+# default; "0" pins the legacy single-peer heal).  See also
+# TORCHFT_HEAL_CHUNK_MB (serialization), TORCHFT_HEAL_MAX_SOURCES
+# (manager_server) and TORCHFT_HEAL_SOURCE_TIMEOUT_S (http_transport).
+HEAL_STRIPED_ENV = "TORCHFT_HEAL_STRIPED"
+
+
+def _heal_striped_enabled() -> bool:
+    return os.environ.get(HEAL_STRIPED_ENV, "1").lower() not in ("0", "false")
 
 
 def _env_timeout(env: str, default_s: float) -> float:
@@ -506,51 +516,81 @@ class Manager:
             # (``manager.py:746-813``); here the quorum thread *is* the
             # recovery lane and the event fences should_commit.
             recovery_event = Event()
+            # striped healing engages only when the quorum advertised 2+
+            # up-to-date sources (wire v2) and the env gate is on; the
+            # single-peer path below is the byte-for-byte legacy behavior
+            # and the automatic P=1 fallback
+            striped_sources = (
+                quorum.recover_src_replica_ranks if _heal_striped_enabled() else []
+            )
+            i_am_striped_source = (
+                len(striped_sources) > 1
+                and replica_rank in striped_sources
+                and bool(quorum.all_recover_dst_replica_ranks)
+            )
             try:
-                if quorum.recover_dst_replica_ranks:
-                    self._logger.info(
-                        f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
-                    )
+                send_dsts = (
+                    list(quorum.all_recover_dst_replica_ranks)
+                    if i_am_striped_source
+                    else list(quorum.recover_dst_replica_ranks)
+                )
+                if send_dsts:
+                    self._logger.info(f"peers need recovery from us {send_dsts}")
                     t_send = time.monotonic()
-                    self._checkpoint_transport.send_checkpoint(
-                        dst_ranks=quorum.recover_dst_replica_ranks,
-                        step=max_step,
-                        state_dict=self._manager_state_dict(),
-                        timeout=self._timeout,
-                    )
+                    if i_am_striped_source:
+                        self._checkpoint_transport.send_checkpoint_striped(
+                            dst_ranks=send_dsts,
+                            step=max_step,
+                            state_dict=self._manager_state_dict(),
+                            timeout=self._timeout,
+                            source_index=striped_sources.index(replica_rank),
+                            num_sources=len(striped_sources),
+                        )
+                    else:
+                        self._checkpoint_transport.send_checkpoint(
+                            dst_ranks=send_dsts,
+                            step=max_step,
+                            state_dict=self._manager_state_dict(),
+                            timeout=self._timeout,
+                        )
                     timings["heal_send_s"] = time.monotonic() - t_send
 
                 if heal:
                     t_recv = time.monotonic()
                     self._healing = True
-                    self._logger.info(
-                        "healing required, fetching checkpoint metadata from "
-                        f"{quorum.recover_src_manager_address} max_step={max_step}"
-                    )
-                    primary_client = self._peer_client_factory(
-                        quorum.recover_src_manager_address
-                    )
-                    checkpoint_metadata = primary_client._checkpoint_metadata(
-                        self._group_rank, timeout=self._timeout
-                    )
-                    primary_client.close()
-                    recover_src_replica_rank = quorum.recover_src_replica_rank
-                    assert recover_src_replica_rank is not None, (
-                        "must have a recover rank when healing"
-                    )
-                    self._logger.info(
-                        f"fetching checkpoint from {recover_src_replica_rank=} "
-                        f"with {checkpoint_metadata=}"
-                    )
-                    # applied on the main thread at should_commit when safe
-                    self._pending_state_dict = (
-                        self._checkpoint_transport.recv_checkpoint(
-                            src_rank=recover_src_replica_rank,
-                            metadata=checkpoint_metadata,
-                            step=max_step,
-                            timeout=self._timeout,
+                    if len(striped_sources) > 1:
+                        self._pending_state_dict = self._recv_striped_checkpoint(
+                            quorum.heal_sources(), max_step, timings
                         )
-                    )
+                    else:
+                        self._logger.info(
+                            "healing required, fetching checkpoint metadata from "
+                            f"{quorum.recover_src_manager_address} max_step={max_step}"
+                        )
+                        primary_client = self._peer_client_factory(
+                            quorum.recover_src_manager_address
+                        )
+                        checkpoint_metadata = primary_client._checkpoint_metadata(
+                            self._group_rank, timeout=self._timeout
+                        )
+                        primary_client.close()
+                        recover_src_replica_rank = quorum.recover_src_replica_rank
+                        assert recover_src_replica_rank is not None, (
+                            "must have a recover rank when healing"
+                        )
+                        self._logger.info(
+                            f"fetching checkpoint from {recover_src_replica_rank=} "
+                            f"with {checkpoint_metadata=}"
+                        )
+                        # applied on the main thread at should_commit when safe
+                        self._pending_state_dict = (
+                            self._checkpoint_transport.recv_checkpoint(
+                                src_rank=recover_src_replica_rank,
+                                metadata=checkpoint_metadata,
+                                step=max_step,
+                                timeout=self._timeout,
+                            )
+                        )
                     self.load_state_dict(
                         cast(Dict[str, int], self._pending_state_dict["torchft"])
                     )
@@ -561,6 +601,57 @@ class Manager:
                 self.report_error(e)
             recovery_event.record()
             self._recovery_event = recovery_event
+
+    def _recv_striped_checkpoint(
+        self,
+        sources: List,
+        max_step: int,
+        timings: Dict[str, float],
+    ) -> Dict[str, object]:
+        """Striped multi-source heal: collect each source's transport
+        metadata (tolerating unreachable managers — a dead source stays in
+        the list as a positional placeholder so chunk assignments agree
+        across peers) and fetch disjoint chunk ranges from all of them."""
+        self._logger.info(
+            f"healing required, striped fetch from {len(sources)} sources "
+            f"max_step={max_step}"
+        )
+        src_list: List = []
+        for src_rank, addr in sources:
+            metadata: Optional[str] = None
+            try:
+                peer = self._peer_client_factory(addr)
+                metadata = peer._checkpoint_metadata(
+                    self._group_rank, timeout=self._timeout
+                )
+                peer.close()
+            except Exception as e:  # noqa: BLE001 — source-level failover
+                self._logger.warn(
+                    f"heal source {src_rank} at {addr} unreachable: {e}"
+                )
+            src_list.append((src_rank, metadata))
+        if all(metadata is None for _, metadata in src_list):
+            raise RuntimeError(
+                f"no heal source produced checkpoint metadata ({sources})"
+            )
+        state = self._checkpoint_transport.recv_checkpoint_striped(
+            sources=src_list, step=max_step, timeout=self._timeout
+        )
+        metrics = getattr(self._checkpoint_transport, "last_heal_metrics", None)
+        if metrics is not None:
+            from torchft_tpu.observability import log_heal
+
+            timings["heal_bytes"] = float(metrics.bytes_total)
+            timings["heal_bytes_per_sec"] = metrics.bytes_per_sec
+            timings["heal_num_sources"] = float(metrics.num_sources)
+            timings["heal_stolen_chunks"] = float(metrics.stolen_chunks)
+            log_heal(
+                metrics,
+                replica_id=self._replica_id,
+                rank=self._group_rank,
+                quorum_id=self._quorum_id,
+            )
+        return cast(Dict[str, object], state)
 
     def _apply_pending_state_dict(self) -> None:
         assert self._healing, "must be in healing state"

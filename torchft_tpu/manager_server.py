@@ -55,6 +55,12 @@ from torchft_tpu.wire import (
 
 logger = logging.getLogger(__name__)
 
+# Cap on how many peers serve one striped heal (0 = every up-to-date peer).
+# Must be set uniformly across the job: the chunk assignment is positional
+# in the source list, so a mismatched cap would desynchronize senders from
+# the healer.
+HEAL_MAX_SOURCES_ENV = "TORCHFT_HEAL_MAX_SOURCES"
+
 
 def compute_quorum_results(
     replica_id: str,
@@ -119,6 +125,16 @@ def compute_quorum_results(
             recover_src,
         )
 
+    # Striped healing (wire v2): the canonical ascending source set — every
+    # up-to-date replica — so a healer can fetch disjoint chunk ranges from
+    # ALL of them and every source knows to stage/serve.  The list must be
+    # identical on every participant (the CommTransport chunk assignment is
+    # positional), so the optional cap truncates deterministically.
+    striped_sources = up_to_date if recover_dst else []
+    max_sources = int(os.environ.get(HEAL_MAX_SOURCES_ENV, "0") or 0)
+    if max_sources > 0:
+        striped_sources = striped_sources[:max_sources]
+
     return ManagerQuorumResult(
         quorum_id=quorum.quorum_id,
         replica_rank=replica_rank,
@@ -135,6 +151,11 @@ def compute_quorum_results(
         heal=heal,
         commit_failures=max(p.commit_failures for p in participants),
         replica_ids=[p.replica_id for p in participants],
+        recover_src_replica_ranks=striped_sources,
+        recover_src_manager_addresses=[
+            participants[i].address for i in striped_sources
+        ],
+        all_recover_dst_replica_ranks=recover_dst,
     )
 
 

@@ -23,6 +23,7 @@ Everything is opt-in via env (``TORCHFT_USE_OTEL``, ``TORCHFT_LOG_DIR``,
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import json
 import logging
 import os
@@ -35,7 +36,12 @@ USE_OTEL_ENV = "TORCHFT_USE_OTEL"
 LOG_DIR_ENV = "TORCHFT_LOG_DIR"
 TRACE_DIR_ENV = "TORCHFT_TRACE_DIR"
 
-STRUCTURED_LOGGERS = ("torchft_quorums", "torchft_commits", "torchft_errors")
+STRUCTURED_LOGGERS = (
+    "torchft_quorums",
+    "torchft_commits",
+    "torchft_errors",
+    "torchft_heals",
+)
 
 _ATTR_KEYS = (
     "job_id",
@@ -45,6 +51,14 @@ _ATTR_KEYS = (
     "step",
     "commit_result",
     "error",
+    # heal-path counters (torchft_heals; striped checkpoint recovery)
+    "heal_bytes",
+    "heal_duration_s",
+    "heal_bytes_per_sec",
+    "heal_num_sources",
+    "heal_failed_sources",
+    "heal_stolen_chunks",
+    "heal_per_source_bytes",
 )
 
 _initialized = False
@@ -122,6 +136,59 @@ def init_structured_logging(force: bool = False) -> bool:
                 logger.addHandler(h)
         _initialized = True
         return True
+
+
+@dataclasses.dataclass
+class HealMetrics:
+    """Throughput/latency facts of one checkpoint heal, filled by the
+    transport (``last_heal_metrics``) and logged by the manager to the
+    ``torchft_heals`` structured logger.
+
+    ``per_source_bytes`` is keyed by source id (replica rank or metadata
+    URL); ``failed_sources`` lists sources that died or errored mid-heal;
+    ``stolen_chunks`` counts chunk reassignments to a surviving source."""
+
+    step: int = 0
+    num_sources: int = 1
+    bytes_total: int = 0
+    duration_s: float = 0.0
+    per_source_bytes: dict = dataclasses.field(default_factory=dict)
+    failed_sources: list = dataclasses.field(default_factory=list)
+    stolen_chunks: int = 0
+
+    @property
+    def bytes_per_sec(self) -> float:
+        return self.bytes_total / self.duration_s if self.duration_s > 0 else 0.0
+
+    def as_log_extra(self) -> dict:
+        return {
+            "step": self.step,
+            "heal_bytes": self.bytes_total,
+            "heal_duration_s": round(self.duration_s, 4),
+            "heal_bytes_per_sec": round(self.bytes_per_sec, 1),
+            "heal_num_sources": self.num_sources,
+            "heal_failed_sources": list(self.failed_sources),
+            "heal_stolen_chunks": self.stolen_chunks,
+            "heal_per_source_bytes": dict(self.per_source_bytes),
+        }
+
+
+def log_heal(
+    metrics: HealMetrics,
+    replica_id: str = "",
+    rank: int = 0,
+    quorum_id: int = -1,
+) -> None:
+    """Emit one heal record to ``torchft_heals`` (JSON lines / OTLP when
+    structured logging is opted in; free otherwise)."""
+    extra = metrics.as_log_extra()
+    extra.update(
+        job_id=os.environ.get("JOB_ID", "unknown"),
+        replica_id=replica_id,
+        rank=rank,
+        quorum_id=quorum_id,
+    )
+    logging.getLogger("torchft_heals").info("", extra=extra)
 
 
 def traced(name: str):
