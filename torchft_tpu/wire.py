@@ -36,13 +36,31 @@ pyo3 timeout mapping in ``src/lib.rs:673-685``.
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 from dataclasses import dataclass, field
 from enum import IntEnum
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+# Wire version of the MGR_QUORUM_RESP body.  v1 is the original fixed field
+# order; v2 appends the striped-healing fields (every healthy peer's replica
+# rank + manager address, and the full recovery-destination set) AFTER the v1
+# fields, prefixed by this version number.  v1 decoders ignore trailing
+# bytes and v2 decoders treat their absence as "no striping info", so mixed
+# fleets interoperate during a rolling upgrade; pin TORCHFT_WIRE_COMPAT=1 on
+# upgraded servers until every client understands v2.
+MANAGER_QUORUM_WIRE_VERSION = 2
+WIRE_COMPAT_ENV = "TORCHFT_WIRE_COMPAT"
+
+
+def manager_quorum_wire_version() -> int:
+    compat = os.environ.get(WIRE_COMPAT_ENV)
+    if compat:
+        return max(1, min(MANAGER_QUORUM_WIRE_VERSION, int(compat)))
+    return MANAGER_QUORUM_WIRE_VERSION
 
 
 class MsgType(IntEnum):
@@ -290,6 +308,30 @@ class ManagerQuorumResult:
     heal: bool = False
     commit_failures: int = 0
     replica_ids: List[str] = field(default_factory=list)
+    # -- v2 (striped healing) ------------------------------------------------
+    # Canonical ascending list of every max-step replica rank able to serve a
+    # heal, with matching manager addresses.  The ORDER is load-bearing: the
+    # CommTransport chunk assignment is `chunk_idx % len(sources)` against
+    # this exact list on both the sending and healing side.  Empty on v1
+    # peers and when nobody is recovering.
+    recover_src_replica_ranks: List[int] = field(default_factory=list)
+    recover_src_manager_addresses: List[str] = field(default_factory=list)
+    # Every recovering replica rank (the union of all sources' recover_dst
+    # assignments) so EVERY healthy peer — not just the round-robin primary —
+    # stages/serves its checkpoint for a striped heal.
+    all_recover_dst_replica_ranks: List[int] = field(default_factory=list)
+
+    def heal_sources(self) -> List[Tuple[int, str]]:
+        """(replica_rank, manager_address) of every peer able to serve this
+        replica's heal, canonical order; falls back to the single v1
+        recover_src when the v2 fields are absent."""
+        if self.recover_src_replica_ranks:
+            return list(
+                zip(self.recover_src_replica_ranks, self.recover_src_manager_addresses)
+            )
+        if self.recover_src_replica_rank is not None:
+            return [(self.recover_src_replica_rank, self.recover_src_manager_address)]
+        return []
 
     def encode(self, w: Writer) -> None:
         w.i64(self.quorum_id)
@@ -309,6 +351,17 @@ class ManagerQuorumResult:
         w.u32(len(self.replica_ids))
         for rid in self.replica_ids:
             w.string(rid)
+        if manager_quorum_wire_version() >= 2:
+            w.u32(2)
+            w.u32(len(self.recover_src_replica_ranks))
+            for rank in self.recover_src_replica_ranks:
+                w.i64(rank)
+            w.u32(len(self.recover_src_manager_addresses))
+            for addr in self.recover_src_manager_addresses:
+                w.string(addr)
+            w.u32(len(self.all_recover_dst_replica_ranks))
+            for rank in self.all_recover_dst_replica_ranks:
+                w.i64(rank)
 
     @staticmethod
     def decode(r: Reader) -> "ManagerQuorumResult":
@@ -326,6 +379,12 @@ class ManagerQuorumResult:
         out.heal = r.boolean()
         out.commit_failures = r.i64()
         out.replica_ids = [r.string() for _ in range(r.u32())]
+        if not r.done() and r.u32() >= 2:
+            out.recover_src_replica_ranks = [r.i64() for _ in range(r.u32())]
+            out.recover_src_manager_addresses = [
+                r.string() for _ in range(r.u32())
+            ]
+            out.all_recover_dst_replica_ranks = [r.i64() for _ in range(r.u32())]
         return out
 
 
