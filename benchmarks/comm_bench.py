@@ -46,7 +46,12 @@ def _make_comm(backend: str, timeout_s: float = 30.0):
     return TCPCommunicator(timeout_s=timeout_s)
 
 
-def worker(rank: int, store_addr: str, backend: str, mb: int, iters: int) -> None:
+def worker(
+    rank: int, store_addr: str, backend: str, mb: int, iters: int, lanes: str
+) -> None:
+    if lanes:
+        # must land before configure: the mesh resolves lanes per epoch
+        os.environ["TORCHFT_RING_LANES"] = lanes
     comm = _make_comm(backend)
     comm.configure(store_addr, f"bench_{rank}", rank, 2)
     nbytes = mb << 20
@@ -90,7 +95,19 @@ def worker(rank: int, store_addr: str, backend: str, mb: int, iters: int) -> Non
     np.testing.assert_allclose(np.asarray(out)[:8], 2.0 ** (ring_iters + 1))
 
     if rank == 1:
-        print(json.dumps({"backend": backend, "mb": mb, **{k: round(v, 3) for k, v in results.items()}}))
+        lane_stats = comm.lane_stats() if hasattr(comm, "lane_stats") else {}
+        print(
+            json.dumps(
+                {
+                    "backend": backend,
+                    "mb": mb,
+                    # tiers without counters (cpp) report the requested knob
+                    # verbatim ("auto"/"" included) rather than a guess
+                    "lanes": lane_stats.get("lanes", lanes or "default"),
+                    **{k: round(v, 3) for k, v in results.items()},
+                }
+            )
+        )
     comm.shutdown()
 
 
@@ -103,12 +120,19 @@ def main() -> None:
     )
     p.add_argument("--mb", type=int, default=64)
     p.add_argument("--iters", type=int, default=10)
+    p.add_argument(
+        "--lanes",
+        default="",
+        help="TORCHFT_RING_LANES for both ranks (int or 'auto'; default env)",
+    )
     p.add_argument("--rank", type=int, default=-1)
     p.add_argument("--store", default="")
     args = p.parse_args()
 
     if args.rank >= 0:
-        worker(args.rank, args.store, args.backend, args.mb, args.iters)
+        worker(
+            args.rank, args.store, args.backend, args.mb, args.iters, args.lanes
+        )
         return
 
     from torchft_tpu.store import StoreServer
@@ -120,7 +144,8 @@ def main() -> None:
             [
                 sys.executable, os.path.abspath(__file__),
                 "--backend", args.backend, "--mb", str(args.mb),
-                "--iters", str(args.iters), "--rank", str(r), "--store", addr,
+                "--iters", str(args.iters), "--lanes", args.lanes,
+                "--rank", str(r), "--store", addr,
             ]
         )
         for r in range(2)

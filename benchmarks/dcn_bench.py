@@ -97,6 +97,32 @@ def _rank_main(rank, world, port, mb, iters, gbps, rtt_ms, out_q):
             assert got["params"].nbytes == state["params"].nbytes
     results["heal_s"] = (time.perf_counter() - t0) / max(1, iters // 2)
     results["heal_GBps"] = heal_bytes / results["heal_s"] / 1e9
+    comm.barrier().wait(timeout=60.0)
+
+    # lane sweep: the SAME f32 ring at explicit lane counts (fresh mesh per
+    # count — lanes are fixed per epoch at configure).  Multi-lane results
+    # must be bit-identical to single-lane: striping moves bytes, not math.
+    ref = None
+    for lanes in (1, 2, 4):
+        os.environ["TORCHFT_RING_LANES"] = str(lanes)
+        comm.configure(
+            f"127.0.0.1:{port}/dcn_{gbps}_{rtt_ms}_L{lanes}",
+            replica_id=f"r{rank}",
+            rank=rank,
+            world_size=world,
+        )
+        out = np.asarray(comm.allreduce(buf.copy()).wait(timeout=300.0))  # warm
+        if ref is None:
+            ref = out
+        else:
+            assert np.array_equal(ref, out), (
+                f"{lanes}-lane ring diverged from 1-lane"
+            )
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            comm.allreduce(buf.copy()).wait(timeout=300.0)
+        results[f"allreduce_{lanes}lane_s"] = (time.perf_counter() - t0) / iters
+    os.environ.pop("TORCHFT_RING_LANES", None)
 
     comm.barrier().wait(timeout=60.0)
     comm.shutdown()
@@ -207,6 +233,16 @@ def run_profile(name, gbps, rtt_ms, mb, iters):
         quant_ring_algo_GBps=round(payload / res["quant_ring_s"] / 1e9, 3),
         quant_speedup=round(res["f32_ring_s"] / res["quant_ring_s"], 3),
     )
+    for lanes in (1, 2, 4):
+        key = f"allreduce_{lanes}lane_s"
+        if key in res:
+            res[f"allreduce_{lanes}lane_GBps"] = round(
+                payload / res[key] / 1e9, 3
+            )
+    if "allreduce_1lane_s" in res and "allreduce_4lane_s" in res:
+        res["allreduce_4lane_speedup"] = round(
+            res["allreduce_1lane_s"] / res["allreduce_4lane_s"], 3
+        )
     return {k: (round(v, 4) if isinstance(v, float) else v) for k, v in res.items()}
 
 
@@ -287,6 +323,19 @@ def main():
                 f"| **{r['quant_speedup']}x** "
                 f"| {r['heal_s']*1e3:.0f} ms ({r['heal_GBps']:.2f} GB/s) "
                 f"| {striped} |"
+            )
+        print()
+        print("| profile | 1 lane | 2 lanes | 4 lanes | 4-lane speedup |")
+        print("|---|---|---|---|---|")
+        for r in rows:
+            if "allreduce_1lane_GBps" not in r:
+                continue
+            print(
+                f"| {r['profile']} "
+                f"| {r['allreduce_1lane_GBps']} GB/s "
+                f"| {r['allreduce_2lane_GBps']} GB/s "
+                f"| {r['allreduce_4lane_GBps']} GB/s "
+                f"| **{r['allreduce_4lane_speedup']}x** |"
             )
 
 

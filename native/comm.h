@@ -133,6 +133,31 @@ struct CommError : std::runtime_error {
   using std::runtime_error::runtime_error;
 };
 
+// Parallel-connection ("lane") config for striped collectives — must agree
+// with the Python tier (torchft_tpu/communicator.py _ring_lanes /
+// _stripe_floor) and be uniform across ranks (verified in the rendezvous
+// hello).  The native tier has no network emulator, so "auto" resolves to 1
+// here; set an explicit integer in mixed-tier deployments.
+inline size_t ring_lanes_from_env() {
+  const char* v = std::getenv("TORCHFT_RING_LANES");
+  if (!v || !*v || std::string(v) == "auto") return 1;
+  long n = std::strtol(v, nullptr, 10);
+  return n >= 1 ? static_cast<size_t>(n) : 1;
+}
+
+inline size_t stripe_floor_from_env() {
+  const char* v = std::getenv("TORCHFT_RING_FRAME_KB");
+  if (!v || !*v || std::string(v) == "auto") return size_t(64) << 10;
+  double kb = std::strtod(v, nullptr);
+  size_t b = static_cast<size_t>(kb * 1024);
+  return b < 64 ? 64 : b;
+}
+
+// High bit of the hello's rank field marks the extended (multi-lane) hello:
+// (rank|flag, lane, lane count, stripe floor).  Must match the Python
+// tier's _LANE_HELLO_FLAG.
+constexpr uint64_t kLaneHelloFlag = uint64_t(1) << 63;
+
 class Communicator {
  public:
   explicit Communicator(double timeout_s) : timeout_s_(timeout_s) {}
@@ -143,7 +168,11 @@ class Communicator {
   }
 
   // Rendezvous over the store: publish our listener under "{prefix}/{rank}";
-  // for each pair (i, j) with i < j, j dials i.  store_prefixed_addr is
+  // for each pair (i, j) with i < j, j dials i — once per LANE.  Lanes are
+  // parallel TCP connections one logical collective stripes frames across
+  // (lane_parts); the Python tier (_TcpMesh) speaks the identical protocol:
+  // legacy 8-byte hello (rank) at 1 lane, 24-byte hello (rank, lane, lane
+  // count) otherwise, lane count verified loudly.  store_prefixed_addr is
   // "host:port/prefix/..." exactly like the Python tier.
   void configure(const std::string& store_prefixed_addr, int64_t rank,
                  int64_t world_size) {
@@ -152,12 +181,15 @@ class Communicator {
       // old fds go to the graveyard (closed at destruction): an op thread
       // may still reference them, and closing now could recycle fd numbers
       std::lock_guard<std::mutex> lock(state_mu_);
-      for (auto& [peer, fd] : peers_) graveyard_.push_back(fd);
+      for (auto& [peer, fds] : peers_)
+        for (int fd : fds) graveyard_.push_back(fd);
       peers_.clear();
     }
     aborted_ = false;
     rank_ = rank;
     world_size_ = world_size;
+    lanes_ = ring_lanes_from_env();
+    stripe_floor_ = stripe_floor_from_env();
     if (world_size <= 1) return;
 
     auto slash = store_prefixed_addr.find('/');
@@ -185,8 +217,9 @@ class Communicator {
               host_str + ":" + std::to_string(port));
 
     // accept from higher ranks on a helper thread while dialing lower ranks
-    int expected_inbound = static_cast<int>(world_size - rank - 1);
-    std::map<int64_t, int> inbound;
+    int expected_inbound =
+        static_cast<int>((world_size - rank - 1) * lanes_);
+    std::map<int64_t, std::vector<int>> inbound;
     std::string accept_err;
     // bound the whole accept phase: a dead higher-rank peer must not wedge
     // configure() (the Python twin sets listener.settimeout(timeout_s))
@@ -199,48 +232,89 @@ class Communicator {
             throw CommError("rendezvous accept timed out or failed");
           configure_socket(conn);
           set_recv_timeout(conn, timeout_s_);
-          uint64_t peer_rank;
-          recv_exact(conn, &peer_rank, 8);
-          inbound[static_cast<int64_t>(peer_rank)] = conn;
+          uint64_t first;
+          recv_exact(conn, &first, 8);
+          if (!(first & kLaneHelloFlag)) {
+            // legacy 8-byte hello: a single-lane peer.  A lane mismatch is
+            // a config error — fail LOUDLY instead of desynchronizing.
+            if (lanes_ != 1)
+              throw CommError(
+                  "lane-count mismatch: rank " + std::to_string(first) +
+                  " has 1 lane, we have " + std::to_string(lanes_) +
+                  " (TORCHFT_RING_LANES must be uniform)");
+            auto& fds = inbound[static_cast<int64_t>(first)];
+            fds.assign(1, conn);
+          } else {
+            uint64_t tail[3];  // lane, lane count, stripe floor
+            recv_exact(conn, tail, 24);
+            uint64_t peer_rank = first & ~kLaneHelloFlag;
+            if (tail[1] != lanes_)
+              throw CommError(
+                  "lane-count mismatch: rank " + std::to_string(peer_rank) +
+                  " has " + std::to_string(tail[1]) + " lanes, we have " +
+                  std::to_string(lanes_) +
+                  " (TORCHFT_RING_LANES must be uniform)");
+            if (tail[2] != stripe_floor_)
+              throw CommError(
+                  "stripe-floor mismatch: rank " + std::to_string(peer_rank) +
+                  " has " + std::to_string(tail[2]) + " bytes, we have " +
+                  std::to_string(stripe_floor_) +
+                  " (TORCHFT_RING_FRAME_KB must be uniform)");
+            auto& fds = inbound[static_cast<int64_t>(peer_rank)];
+            if (fds.size() < lanes_) fds.resize(lanes_, -1);
+            fds[tail[0]] = conn;
+          }
         }
       } catch (const std::exception& e) {
         accept_err = e.what();
       }
     });
 
-    std::map<int64_t, int> fresh;
+    std::map<int64_t, std::vector<int>> fresh;
     try {
       for (int64_t peer = 0; peer < rank_; ++peer) {
         std::string addr =
             store.get(prefix + "/" + std::to_string(peer), timeout_s_);
-        int fd = dial(addr, timeout_s_);
-        uint64_t my_rank = static_cast<uint64_t>(rank_);
-        send_all(fd, &my_rank, 8);
-        fresh[peer] = fd;
+        auto& fds = fresh[peer];
+        for (size_t lane = 0; lane < lanes_; ++lane) {
+          int fd = dial(addr, timeout_s_);
+          if (lanes_ == 1) {
+            uint64_t my_rank = static_cast<uint64_t>(rank_);
+            send_all(fd, &my_rank, 8);
+          } else {
+            uint64_t hello[4] = {static_cast<uint64_t>(rank_) | kLaneHelloFlag,
+                                 lane, lanes_, stripe_floor_};
+            send_all(fd, hello, 32);
+          }
+          fds.push_back(fd);
+        }
       }
       acceptor.join();
       if (!accept_err.empty())
         throw CommError("rendezvous accept failed: " + accept_err);
-      for (auto& [peer, fd] : inbound) fresh[peer] = fd;
+      for (auto& [peer, fds] : inbound) fresh[peer] = fds;
     } catch (...) {
       if (acceptor.joinable()) acceptor.join();
-      for (auto& [peer, fd] : fresh) ::close(fd);
+      for (auto& [peer, fds] : fresh)
+        for (int fd : fds) ::close(fd);
       ::close(listen_fd);
       throw;
     }
     ::close(listen_fd);
 
-    for (auto& [peer, fd] : fresh) {
-      // NB: no explicit SO_SNDBUF/SO_RCVBUF — setting them disables the
-      // kernel's TCP buffer autotuning, which reaches larger effective
-      // windows than the rmem/wmem_max caps allow explicitly
-      int one = 1;
-      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      // blocking IO with a short timeout quantum: throughput of plain
-      // send/recv, abort/deadline checks every quantum on EAGAIN
-      timeval tv{0, 200000};  // 200ms
-      ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    for (auto& [peer, fds] : fresh) {
+      for (int fd : fds) {
+        // NB: no explicit SO_SNDBUF/SO_RCVBUF — setting them disables the
+        // kernel's TCP buffer autotuning, which reaches larger effective
+        // windows than the rmem/wmem_max caps allow explicitly
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        // blocking IO with a short timeout quantum: throughput of plain
+        // send/recv, abort/deadline checks every quantum on EAGAIN
+        timeval tv{0, 200000};  // 200ms
+        ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+        ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      }
     }
     {
       std::lock_guard<std::mutex> lock(state_mu_);
@@ -254,20 +328,40 @@ class Communicator {
     // valid.  close happens at destruction.
     aborted_ = true;
     std::lock_guard<std::mutex> lock(state_mu_);
-    for (auto& [peer, fd] : peers_) ::shutdown(fd, SHUT_RDWR);
+    for (auto& [peer, fds] : peers_)
+      for (int fd : fds) ::shutdown(fd, SHUT_RDWR);
   }
 
   void close_peers() {
     std::lock_guard<std::mutex> lock(state_mu_);
-    for (auto& [peer, fd] : peers_) ::close(fd);
+    for (auto& [peer, fds] : peers_)
+      for (int fd : fds) ::close(fd);
     peers_.clear();
     for (int fd : graveyard_) ::close(fd);
     graveyard_.clear();
   }
 
-  std::map<int64_t, int> peers_snapshot() const {
+  std::map<int64_t, std::vector<int>> peers_snapshot() const {
     std::lock_guard<std::mutex> lock(state_mu_);
     return peers_;
+  }
+
+  // deterministic per-lane split of one frame; identical math to the Python
+  // tier (_lane_parts): both endpoints derive the split from the frame
+  // length alone, 64-byte aligned so no element ever straddles lanes
+  std::vector<std::pair<size_t, size_t>> lane_parts(size_t nbytes) const {
+    if (lanes_ <= 1 || nbytes < 2 * stripe_floor_) return {{0, nbytes}};
+    size_t k = std::min(lanes_, std::max<size_t>(1, nbytes / stripe_floor_));
+    if (k <= 1) return {{0, nbytes}};
+    std::vector<size_t> bounds{0};
+    for (size_t i = 1; i < k; ++i) {
+      size_t cut = (i * nbytes / k) / 64 * 64;
+      bounds.push_back(std::max(cut, bounds.back()));
+    }
+    bounds.push_back(nbytes);
+    std::vector<std::pair<size_t, size_t>> parts;
+    for (size_t i = 0; i < k; ++i) parts.emplace_back(bounds[i], bounds[i + 1]);
+    return parts;
   }
 
   int64_t rank() const { return rank_; }
@@ -325,21 +419,20 @@ class Communicator {
           },
           3000, deadline);
     } else {
-      exchange(-1, 0, nullptr, 0, root, 3000, data, nbytes, deadline);
+      recv_striped(peer_fds(root), root, 3000, data, nbytes, deadline);
     }
   }
 
   void send(const void* data, size_t nbytes, int64_t dst, uint64_t tag) {
     auto deadline = deadline_in(timeout_s_);
-    exchange(dst, tag, const_cast<void*>(data), nbytes, -1, 0, nullptr, 0,
-             deadline);
+    send_framed(p2p_fd(dst), dst, tag, data, nbytes, deadline);
   }
 
   // zero-copy: receive one frame directly into a caller buffer; returns
   // the payload size (must be <= cap)
   size_t recv_into(int64_t src, uint64_t tag, void* buf, size_t cap) {
     auto deadline = deadline_in(timeout_s_);
-    int fd = peer_fd(src);
+    int fd = p2p_fd(src);
     uint64_t hdr[2];
     recv_loop(fd, src, hdr, 16, deadline);
     if (hdr[1] != tag)
@@ -363,7 +456,7 @@ class Communicator {
   // receiver learns the size from the frame header
   std::vector<uint8_t> recv_dynamic(int64_t src, uint64_t tag) {
     auto deadline = deadline_in(timeout_s_);
-    int fd = peer_fd(src);
+    int fd = p2p_fd(src);
     uint64_t hdr[2];
     recv_loop(fd, src, hdr, 16, deadline);
     if (hdr[1] != tag)
@@ -413,16 +506,7 @@ class Communicator {
                        std::chrono::duration<double>(seconds));
   }
 
-  static int peer_fd_in(const std::map<int64_t, int>& peers, int64_t peer,
-                        bool aborted) {
-    auto it = peers.find(peer);
-    if (it == peers.end())
-      throw CommError("no peer " + std::to_string(peer) +
-                      (aborted ? " (communicator aborted)" : ""));
-    return it->second;
-  }
-
-  int peer_fd(int64_t peer) {
+  std::vector<int> peer_fds(int64_t peer) {
     std::lock_guard<std::mutex> lock(state_mu_);
     auto it = peers_.find(peer);
     if (it == peers_.end())
@@ -430,6 +514,20 @@ class Communicator {
                       (aborted_ ? " (communicator aborted)" : ""));
     return it->second;
   }
+
+  int peer_fd(int64_t peer, size_t lane = 0) {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    auto it = peers_.find(peer);
+    if (it == peers_.end() || lane >= it->second.size())
+      throw CommError("no peer " + std::to_string(peer) +
+                      (aborted_ ? " (communicator aborted)" : ""));
+    return it->second[lane];
+  }
+
+  // point-to-point ops ride the LAST lane whole (the only lane at lanes==1,
+  // wire-identical to the pre-lane build) — heal traffic off lane 0, where
+  // collective control frames concentrate; matches _TcpMesh.p2p_sock
+  int p2p_fd(int64_t peer) { return peer_fd(peer, lanes_ - 1); }
 
   void check_abort() const {
     if (aborted_) throw CommError("communicator aborted");
@@ -474,6 +572,84 @@ class Communicator {
     }
   }
 
+  // --- lane-striped framed IO ---------------------------------------------
+  //
+  // One logical frame split across the lane connections by lane_parts();
+  // part 0 runs on the calling thread, the rest on short-lived lane
+  // threads, so on cwnd-limited links the streams genuinely run in
+  // parallel.  Sub-frame boundaries are 64-byte aligned, so the reduce
+  // variant can fold each lane's range independently — every element still
+  // sees exactly one reduction per step: results are bit-identical to a
+  // single lane.
+
+  template <typename PartFn>
+  void run_lane_parts(const std::vector<std::pair<size_t, size_t>>& parts,
+                      PartFn fn) {
+    if (parts.size() == 1) {
+      fn(0, parts[0].first, parts[0].second);
+      return;
+    }
+    std::mutex err_mu;
+    std::string first_err;
+    std::vector<std::thread> threads;
+    for (size_t i = 1; i < parts.size(); ++i) {
+      threads.emplace_back([&, i] {
+        try {
+          fn(i, parts[i].first, parts[i].second);
+        } catch (const std::exception& e) {
+          std::lock_guard<std::mutex> lock(err_mu);
+          if (first_err.empty()) first_err = e.what();
+        }
+      });
+    }
+    try {
+      fn(0, parts[0].first, parts[0].second);
+    } catch (const std::exception& e) {
+      std::lock_guard<std::mutex> lock(err_mu);
+      if (first_err.empty()) first_err = e.what();
+    }
+    for (auto& t : threads) t.join();
+    if (!first_err.empty()) throw CommError(first_err);
+  }
+
+  void send_striped(const std::vector<int>& fds, int64_t peer, uint64_t tag,
+                    const void* buf, size_t nbytes, TimePoint deadline) {
+    const uint8_t* base = static_cast<const uint8_t*>(buf);
+    run_lane_parts(lane_parts(nbytes), [&](size_t lane, size_t s, size_t e) {
+      send_framed(fds[lane], peer, tag, base + s, e - s, deadline);
+    });
+  }
+
+  void recv_striped(const std::vector<int>& fds, int64_t peer, uint64_t tag,
+                    void* buf, size_t nbytes, TimePoint deadline) {
+    uint8_t* base = static_cast<uint8_t*>(buf);
+    run_lane_parts(lane_parts(nbytes), [&](size_t lane, size_t s, size_t e) {
+      recv_framed(fds[lane], peer, tag, base + s, e - s, deadline);
+    });
+  }
+
+  void recv_striped_reduce(const std::vector<int>& fds, int64_t peer,
+                           uint64_t tag, void* dst, size_t nbytes, DType dt,
+                           RedOp op, TimePoint deadline,
+                           std::vector<std::vector<uint8_t>>& scratches) {
+    uint8_t* base = static_cast<uint8_t*>(dst);
+    auto parts = lane_parts(nbytes);
+    // per-lane scratch from the caller's pool (grown once, reused across
+    // ring steps): the quantum-pipelined reduce runs concurrently on every
+    // lane over disjoint destination ranges
+    if (scratches.size() < parts.size()) scratches.resize(parts.size());
+    for (size_t i = 0; i < parts.size(); ++i) {
+      size_t want =
+          std::min<size_t>(parts[i].second - parts[i].first, size_t(4) << 20) +
+          64;
+      if (scratches[i].size() < want) scratches[i].resize(want);
+    }
+    run_lane_parts(parts, [&](size_t lane, size_t s, size_t e) {
+      recv_framed_reduce(fds[lane], peer, tag, base + s, e - s,
+                         scratches[lane].data(), dt, op, deadline);
+    });
+  }
+
   // element bounds per ring chunk (first n%ws chunks one element longer)
   std::vector<size_t> ring_bounds(size_t n) const {
     int64_t ws = world_size_;
@@ -494,7 +670,6 @@ class Communicator {
     int64_t ws = world_size_;
     int64_t right = (rank_ + 1) % ws;
     int64_t left = (rank_ - 1 + ws) % ws;
-    std::vector<uint8_t> scratch((bounds[1] - bounds[0]) * esz);
     auto chunk_ptr = [&](int64_t i) {
       i = ((i % ws) + ws) % ws;
       return bytes + bounds[i] * esz;
@@ -503,24 +678,25 @@ class Communicator {
       i = ((i % ws) + ws) % ws;
       return (bounds[i + 1] - bounds[i]) * esz;
     };
+    std::vector<int> right_fds = peer_fds(right);
+    std::vector<int> left_fds = peer_fds(left);
+    std::vector<std::vector<uint8_t>> scratches;  // grown once, reused/step
     for (int64_t step = 0; step < ws - 1; ++step) {
       int64_t send_idx = rank_ - step + shift;
       int64_t recv_idx = rank_ - step - 1 + shift;
-      int sfd = peer_fd(right);
-      int rfd = peer_fd(left);
       std::string send_err;
       std::thread sender([&] {
         try {
-          send_framed(sfd, right, 1000 + step, chunk_ptr(send_idx),
-                      chunk_bytes(send_idx), deadline);
+          send_striped(right_fds, right, 1000 + step, chunk_ptr(send_idx),
+                       chunk_bytes(send_idx), deadline);
         } catch (const std::exception& e) {
           send_err = e.what();
         }
       });
       try {
-        recv_framed_reduce(rfd, left, 1000 + step, chunk_ptr(recv_idx),
-                           chunk_bytes(recv_idx), scratch.data(), dt, op,
-                           deadline);
+        recv_striped_reduce(left_fds, left, 1000 + step, chunk_ptr(recv_idx),
+                            chunk_bytes(recv_idx), dt, op, deadline,
+                            scratches);
       } catch (...) {
         sender.join();
         throw;
@@ -545,12 +721,29 @@ class Communicator {
       i = ((i % ws) + ws) % ws;
       return (bounds[i + 1] - bounds[i]) * esz;
     };
+    std::vector<int> right_fds = peer_fds(right);
+    std::vector<int> left_fds = peer_fds(left);
     for (int64_t step = 0; step < ws - 1; ++step) {
       int64_t send_idx = rank_ + 1 + shift - step;
       int64_t recv_idx = rank_ + shift - step;
-      exchange(right, 2000 + step, chunk_ptr(send_idx), chunk_bytes(send_idx),
-               left, 2000 + step, chunk_ptr(recv_idx), chunk_bytes(recv_idx),
-               deadline);
+      std::string send_err;
+      std::thread sender([&] {
+        try {
+          send_striped(right_fds, right, 2000 + step, chunk_ptr(send_idx),
+                       chunk_bytes(send_idx), deadline);
+        } catch (const std::exception& e) {
+          send_err = e.what();
+        }
+      });
+      try {
+        recv_striped(left_fds, left, 2000 + step, chunk_ptr(recv_idx),
+                     chunk_bytes(recv_idx), deadline);
+      } catch (...) {
+        sender.join();
+        throw;
+      }
+      sender.join();
+      if (!send_err.empty()) throw CommError(send_err);
     }
   }
 
@@ -625,66 +818,35 @@ class Communicator {
     }
   }
 
-  // duplex single-pair exchange: a sender thread pushes while this thread
-  // receives — full socket throughput in both directions, deadlock-free
-  // even when both legs share one socket (ws == 2 rings).
-  void exchange(int64_t dst, uint64_t send_tag, void* send_buf,
-                size_t send_bytes, int64_t src, uint64_t recv_tag,
-                void* recv_buf, size_t recv_bytes, TimePoint deadline) {
-    if (dst >= 0 && src >= 0) {
-      int sfd = peer_fd(dst);
-      int rfd = peer_fd(src);
-      std::string send_err;
-      std::thread sender([&] {
-        try {
-          send_framed(sfd, dst, send_tag, send_buf, send_bytes, deadline);
-        } catch (const std::exception& e) {
-          send_err = e.what();
-        }
-      });
-      try {
-        recv_framed(rfd, src, recv_tag, recv_buf, recv_bytes, deadline);
-      } catch (...) {
-        sender.join();
-        throw;
-      }
-      sender.join();
-      if (!send_err.empty()) throw CommError(send_err);
-    } else if (dst >= 0) {
-      send_framed(peer_fd(dst), dst, send_tag, send_buf, send_bytes, deadline);
-    } else if (src >= 0) {
-      recv_framed(peer_fd(src), src, recv_tag, recv_buf, recv_bytes, deadline);
-    }
-  }
-
   // all-peers concurrent exchange (alltoall/allgather/broadcast fan-out):
-  // one duplex worker per peer.
+  // one duplex worker per peer, each leg lane-striped.
   template <typename SendFn, typename RecvFn>
-  void multi_exchange(const std::map<int64_t, int>& peers, SendFn send_for,
-                      RecvFn recv_for, uint64_t tag, TimePoint deadline) {
+  void multi_exchange(const std::map<int64_t, std::vector<int>>& peers,
+                      SendFn send_for, RecvFn recv_for, uint64_t tag,
+                      TimePoint deadline) {
     std::vector<std::thread> workers;
     std::mutex err_mu;
     std::string first_err;
-    for (const auto& [peer, fd] : peers) {
+    for (const auto& [peer, fds] : peers) {
       auto [sb, sn] = send_for(peer);
       auto [rb, rn] = recv_for(peer);
-      workers.emplace_back([this, peer, fd, sb, sn, rb, rn, tag, deadline,
-                            &err_mu, &first_err] {
+      workers.emplace_back([this, peer = peer, fds = fds, sb, sn, rb, rn, tag,
+                            deadline, &err_mu, &first_err] {
         try {
           if (rb == nullptr) {
-            send_framed(fd, peer, tag, sb, sn, deadline);
+            send_striped(fds, peer, tag, sb, sn, deadline);
             return;
           }
           std::string send_err;
           std::thread sender([&] {
             try {
-              send_framed(fd, peer, tag, sb, sn, deadline);
+              send_striped(fds, peer, tag, sb, sn, deadline);
             } catch (const std::exception& e) {
               send_err = e.what();
             }
           });
           try {
-            recv_framed(fd, peer, tag, rb, rn, deadline);
+            recv_striped(fds, peer, tag, rb, rn, deadline);
           } catch (const std::exception& e) {
             sender.join();
             throw CommError(e.what());
@@ -704,12 +866,14 @@ class Communicator {
   double timeout_s_;
   int64_t rank_ = 0;
   int64_t world_size_ = 1;
+  size_t lanes_ = 1;
+  size_t stripe_floor_ = size_t(64) << 10;
   std::atomic<bool> aborted_{false};
   // guards peers_/graveyard_ STRUCTURE only — never held across IO; ops
   // snapshot the fds they need at entry (fds stay open until destruction,
   // so a snapshot can never dangle)
   mutable std::mutex state_mu_;
-  std::map<int64_t, int> peers_;
+  std::map<int64_t, std::vector<int>> peers_;
   std::vector<int> graveyard_;
 };
 

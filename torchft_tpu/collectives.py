@@ -300,19 +300,33 @@ def _allreduce_pipelined_sync(
     err: Optional[BaseException] = None
     out = np.empty(rows * row_size, dtype=np.float32)
 
+    # one padded staging scratch (q rows + their scales), sized for the
+    # largest window and reused across windows — the previous per-window
+    # np.concatenate allocated fresh padding buffers every window.  Reuse is
+    # safe while earlier windows' collectives are still in flight because
+    # ``_pack`` copies the rows into the wire buffer before submission.
+    max_padded = max(
+        (-(-(stop - start) // ws) * ws for start, stop in windows), default=0
+    )
+    pad_q: Optional[np.ndarray] = None
+    pad_s: Optional[np.ndarray] = None
+
     def _submit_a2a(w: int) -> Work:
+        nonlocal pad_q, pad_s
         start, stop = windows[w]
         wq, wsc = q[start:stop], scales[start:stop]
         wrows = stop - start
         rows_per_rank = -(-wrows // ws)
         padded = rows_per_rank * ws
         if padded != wrows:
-            wq = np.concatenate(
-                [wq, np.zeros((padded - wrows, row_size), q.dtype)]
-            )
-            wsc = np.concatenate(
-                [wsc, np.zeros(padded - wrows, np.float32)]
-            )
+            if pad_q is None:
+                pad_q = np.empty((max_padded, row_size), q.dtype)
+                pad_s = np.empty(max_padded, np.float32)
+            pad_q[:wrows] = wq
+            pad_q[wrows:padded] = 0
+            pad_s[:wrows] = wsc
+            pad_s[wrows:padded] = 0.0
+            wq, wsc = pad_q[:padded], pad_s[:padded]
         chunks = [
             _pack(
                 wq[p * rows_per_rank : (p + 1) * rows_per_rank],

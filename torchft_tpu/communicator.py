@@ -207,6 +207,12 @@ class Communicator(ABC):
     def set_timeout(self, timeout_s: float) -> None:
         ...
 
+    def lane_stats(self) -> Dict[str, object]:
+        """Per-lane data-plane counters of the current epoch (lane count,
+        stripe floor, bytes, stall events); empty for tiers without lane
+        striping or before configure."""
+        return {}
+
     def shutdown(self) -> None:
         ...
 
@@ -218,38 +224,22 @@ class Communicator(ABC):
 _HDR = struct.Struct("<QQ")  # payload nbytes, tag
 
 
-class _NetEmu:
-    """Deterministic sender-side network emulation (netem analog) for the
-    TCP tier: a token-bucket bandwidth cap plus a half-RTT gate before each
-    frame's first byte.  Loopback hides the regime the replica dimension is
-    designed for (DCN: ~1-10 Gb/s, 2-10 ms RTT); with this, ring / quantized
-    ring / heal-transfer behavior at DCN profiles is measured rather than
-    extrapolated (``benchmarks/dcn_bench.py``).  Enabled only via env —
-    ``TORCHFT_NET_GBPS`` (link rate, Gbit/s) and ``TORCHFT_NET_RTT_MS`` —
-    and never in production paths by default."""
+class _StreamBucket:
+    """Per-connection token bucket modeling a cwnd-limited TCP stream:
+    rate = cwnd/RTT, burst = cwnd."""
 
-    def __init__(self, gbps: float, rtt_ms: float) -> None:
-        self.bytes_per_s = gbps * 1e9 / 8.0
-        self.half_rtt_s = rtt_ms / 2e3
-        # classic capped token bucket: credit must NOT accrue while idle,
-        # or the first send after any pause bursts at loopback speed and
-        # the measured rate exceeds the emulated link
-        self.burst = max(64 << 10, int(self.bytes_per_s * 0.005))
-        self._tokens = float(self.burst)
+    __slots__ = ("rate", "burst", "_tokens", "_last")
+
+    def __init__(self, rate: float, burst: int) -> None:
+        self.rate = rate
+        self.burst = burst
+        self._tokens = float(burst)
         self._last = time.monotonic()
 
-    def frame_gate(self) -> float:
-        """Earliest monotonic time the next frame may start transmitting."""
-        return time.monotonic() + self.half_rtt_s
-
     def allow(self, want: int) -> int:
-        """Bytes the token bucket permits right now (<= ``want``)."""
-        if self.bytes_per_s <= 0:
-            return want
         now = time.monotonic()
         self._tokens = min(
-            float(self.burst),
-            self._tokens + (now - self._last) * self.bytes_per_s,
+            float(self.burst), self._tokens + (now - self._last) * self.rate
         )
         self._last = now
         return max(0, min(want, int(self._tokens)))
@@ -258,30 +248,239 @@ class _NetEmu:
         self._tokens -= n
 
 
+class _NetEmu:
+    """Deterministic sender-side network emulation (netem analog) for the
+    TCP tier: a shared token-bucket link cap, a per-connection cwnd-limited
+    stream cap, and a half-RTT gate before each frame's first byte.
+    Loopback hides the regime the replica dimension is designed for (DCN:
+    ~1-10 Gb/s, 2-10 ms RTT); with this, ring / quantized ring /
+    heal-transfer behavior at DCN profiles is measured rather than
+    extrapolated (``benchmarks/dcn_bench.py``).
+
+    The stream cap is what makes multi-lane striping measurable: a single
+    TCP stream on a long-RTT path is limited by min(link, cwnd/RTT), so one
+    connection cannot saturate the link — exactly the underutilization the
+    lane striping in :class:`_TcpMesh` exists to cure.  Default cwnd is
+    ``TORCHFT_NET_CWND_KB`` (256 KiB; ``0`` disables the stream cap and
+    restores the pure link-rate model); it only engages when RTT > 0.
+
+    Enabled only via env — ``TORCHFT_NET_EMU`` (a named profile:
+    ``wan_1g`` = 1 Gb/s / 10 ms, ``dcn_10g`` = 10 Gb/s / 2 ms) or the raw
+    ``TORCHFT_NET_GBPS`` (link rate, Gbit/s) and ``TORCHFT_NET_RTT_MS``
+    knobs — and never in production paths by default."""
+
+    def __init__(
+        self, gbps: float, rtt_ms: float, cwnd_bytes: int = 256 << 10
+    ) -> None:
+        self.bytes_per_s = gbps * 1e9 / 8.0
+        self.half_rtt_s = rtt_ms / 2e3
+        self.rtt_s = rtt_ms / 1e3
+        # per-stream throughput cap (cwnd/RTT); 0 = uncapped
+        self.stream_bytes_per_s = (
+            cwnd_bytes / self.rtt_s if cwnd_bytes > 0 and self.rtt_s > 0 else 0.0
+        )
+        self.cwnd_bytes = cwnd_bytes
+        # classic capped token bucket: credit must NOT accrue while idle,
+        # or the first send after any pause bursts at loopback speed and
+        # the measured rate exceeds the emulated link
+        self.burst = max(64 << 10, int(self.bytes_per_s * 0.005))
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+        self._streams: Dict[object, _StreamBucket] = {}
+
+    def frame_gate(self) -> float:
+        """Earliest monotonic time the next frame may start transmitting."""
+        return time.monotonic() + self.half_rtt_s
+
+    def bdp_bytes(self) -> int:
+        """RTT × bandwidth product of the emulated link (0 when either is
+        unshaped) — the natural frame size on this profile."""
+        if self.bytes_per_s <= 0 or self.rtt_s <= 0:
+            return 0
+        return int(self.bytes_per_s * self.rtt_s)
+
+    def allow(self, want: int, stream: object = None) -> int:
+        """Bytes the link (and, when RTT emulation is on, ``stream``'s cwnd
+        bucket) permit right now (<= ``want``)."""
+        if self.bytes_per_s > 0:
+            now = time.monotonic()
+            self._tokens = min(
+                float(self.burst),
+                self._tokens + (now - self._last) * self.bytes_per_s,
+            )
+            self._last = now
+            want = max(0, min(want, int(self._tokens)))
+        if stream is not None and self.stream_bytes_per_s > 0 and want > 0:
+            bucket = self._streams.get(stream)
+            if bucket is None:
+                bucket = self._streams[stream] = _StreamBucket(
+                    self.stream_bytes_per_s, self.cwnd_bytes
+                )
+            want = bucket.allow(want)
+        return want
+
+    def consume(self, n: int, stream: object = None) -> None:
+        self._tokens -= n
+        if stream is not None and self.stream_bytes_per_s > 0:
+            bucket = self._streams.get(stream)
+            if bucket is not None:
+                bucket.consume(n)
+
+
+# named emulation profiles (TORCHFT_NET_EMU): (link Gbit/s, RTT ms).  The
+# aliases with the explicit RTT suffix match benchmarks/dcn_bench.py's
+# profile names, so a bench row can be reproduced verbatim from env.
+_NET_EMU_PROFILES = {
+    "wan_1g": (1.0, 10.0),
+    "wan_1g_10ms": (1.0, 10.0),
+    "dcn_10g": (10.0, 2.0),
+    "dcn_10g_2ms": (10.0, 2.0),
+    "loopback": (0.0, 0.0),
+}
+
+
 def _net_emu_from_env() -> Optional["_NetEmu"]:
+    profile = os.environ.get("TORCHFT_NET_EMU", "").strip().lower()
+    prof_gbps, prof_rtt = 0.0, 0.0
+    if profile:
+        if profile not in _NET_EMU_PROFILES:
+            # loud, not silent: a typo'd profile would otherwise run
+            # UNSHAPED and record loopback numbers as a DCN profile
+            raise CommunicatorError(
+                f"unknown TORCHFT_NET_EMU profile {profile!r}; "
+                f"valid: {sorted(_NET_EMU_PROFILES)}"
+            )
+        prof_gbps, prof_rtt = _NET_EMU_PROFILES[profile]
     try:
-        gbps = float(os.environ.get("TORCHFT_NET_GBPS", "0") or 0.0)
-        rtt_ms = float(os.environ.get("TORCHFT_NET_RTT_MS", "0") or 0.0)
+        gbps = float(os.environ.get("TORCHFT_NET_GBPS", "") or prof_gbps)
+        rtt_ms = float(os.environ.get("TORCHFT_NET_RTT_MS", "") or prof_rtt)
+        cwnd = int(
+            float(os.environ.get("TORCHFT_NET_CWND_KB", "") or 256) * 1024
+        )
     except ValueError as e:
-        # loud, not silent: an unparseable knob ("10g") would otherwise run
-        # UNSHAPED and record loopback numbers as a DCN profile
         raise CommunicatorError(
             "unparseable network-emulation knob: "
             f"TORCHFT_NET_GBPS={os.environ.get('TORCHFT_NET_GBPS')!r} "
-            f"TORCHFT_NET_RTT_MS={os.environ.get('TORCHFT_NET_RTT_MS')!r}"
+            f"TORCHFT_NET_RTT_MS={os.environ.get('TORCHFT_NET_RTT_MS')!r} "
+            f"TORCHFT_NET_CWND_KB={os.environ.get('TORCHFT_NET_CWND_KB')!r}"
         ) from e
     if gbps <= 0 and rtt_ms <= 0:
         return None
-    return _NetEmu(gbps, rtt_ms)
+    return _NetEmu(gbps, rtt_ms, cwnd)
+
+
+# ---------------------------------------------------------------------------
+# lane striping
+# ---------------------------------------------------------------------------
+
+# Parallel-connection ("lane") count for ring collectives.  One TCP stream
+# on a long-RTT DCN path is cwnd-limited far below the link rate; striping
+# each ring chunk across L independent connections is the standard cure
+# (cf. PAPERS.md: HSDP-at-100k-GPUs / SPARe stripe inter-replica reduction
+# the same way).  MUST be uniform across replicas (verified loudly at
+# rendezvous); "auto"/unset derives it from the emulated link profile (1 on
+# plain loopback, where a single stream already saturates).
+RING_LANES_ENV = "TORCHFT_RING_LANES"
+# Floor for one striped sub-frame, in KiB.  Unset/auto picks the link's
+# RTT×bandwidth product (jumbo frames on DCN so the per-frame half-RTT gate
+# amortizes; 64 KiB on loopback).  Uniform across replicas, like the lanes.
+RING_FRAME_KB_ENV = "TORCHFT_RING_FRAME_KB"
+_MAX_AUTO_LANES = 4
+_MIN_STRIPE_BYTES = 64 << 10
+# sub-frame boundaries are 64-byte aligned so no element of any supported
+# dtype (itemsize a power of two <= 64) ever splits across lanes — the
+# receive path can reduce a completed part without waiting for its siblings
+_STRIPE_ALIGN = 64
+
+# High bit of the rendezvous hello's rank field marks the EXTENDED hello
+# (rank|flag, lane, lane count, stripe floor; 32 bytes), sent whenever
+# lanes > 1.  A single-lane build sends the legacy 8-byte rank hello —
+# wire-identical to every pre-lane build — and the flag bit lets EITHER
+# side detect a lane-config disagreement from the first 8 bytes and fail
+# loudly, instead of wedging on missing hello bytes or misparsing the
+# extended hello's tail as a frame header.  (Ranks are tiny integers; the
+# top bit is never a real rank.)
+_LANE_HELLO_FLAG = 1 << 63
+
+
+def _ring_lanes(emu: Optional[_NetEmu]) -> int:
+    raw = os.environ.get(RING_LANES_ENV, "").strip().lower()
+    if raw and raw != "auto":
+        try:
+            lanes = int(raw)
+        except ValueError as e:
+            raise CommunicatorError(
+                f"unparseable {RING_LANES_ENV}={raw!r} (int or 'auto')"
+            ) from e
+        if lanes < 1:
+            raise CommunicatorError(f"{RING_LANES_ENV} must be >= 1")
+        return lanes
+    # auto: enough lanes that the aggregate stream rate reaches the link
+    # rate, capped; 1 when unshaped (loopback) or the stream cap is off
+    if emu is None or emu.stream_bytes_per_s <= 0 or emu.bytes_per_s <= 0:
+        return 1
+    need = -(-int(emu.bytes_per_s) // max(1, int(emu.stream_bytes_per_s)))
+    return max(1, min(_MAX_AUTO_LANES, need))
+
+
+def _stripe_floor(emu: Optional[_NetEmu]) -> int:
+    raw = os.environ.get(RING_FRAME_KB_ENV, "").strip().lower()
+    if raw and raw != "auto":
+        try:
+            return max(_STRIPE_ALIGN, int(float(raw) * 1024))
+        except ValueError as e:
+            raise CommunicatorError(
+                f"unparseable {RING_FRAME_KB_ENV}={raw!r} (KiB or 'auto')"
+            ) from e
+    if emu is not None:
+        bdp = emu.bdp_bytes()
+        if bdp > 0:
+            # jumbo frames on DCN: one sub-frame covers at least a BDP so
+            # the half-RTT frame gate amortizes over a full pipe of bytes
+            return max(_MIN_STRIPE_BYTES, min(bdp, 8 << 20))
+    return _MIN_STRIPE_BYTES
+
+
+def _lane_parts(
+    nbytes: int, lanes: int, floor: int
+) -> List[Tuple[int, int, int]]:
+    """Deterministic split of one ``nbytes`` frame into per-lane sub-frames:
+    ``[(lane, start, stop), ...]``.  Both endpoints compute this from the
+    frame length alone, so no extra wire metadata is needed; the native tier
+    (``native/comm.h lane_parts``) implements the identical math so the
+    tiers stay wire-compatible at any lane count.  Payloads smaller than
+    two floors ride lane 0 whole (striping tiny frames only adds per-frame
+    overhead)."""
+    if lanes <= 1 or nbytes < 2 * floor:
+        return [(0, 0, nbytes)]
+    k = min(lanes, max(1, nbytes // floor))
+    if k <= 1:
+        return [(0, 0, nbytes)]
+    bounds = [0]
+    for i in range(1, k):
+        cut = (i * nbytes // k) // _STRIPE_ALIGN * _STRIPE_ALIGN
+        bounds.append(max(cut, bounds[-1]))
+    bounds.append(nbytes)
+    return [(lane, bounds[lane], bounds[lane + 1]) for lane in range(k)]
 
 
 class _TcpMesh:
-    """Full mesh of rank-to-rank sockets for one quorum epoch.
+    """Full mesh of rank-to-rank lane sockets for one quorum epoch.
 
     Rendezvous: every rank publishes its listener under ``{prefix}/{rank}``
-    in the store; for each pair (i, j) with i < j, j dials i.  All data ops
-    for the epoch run on a single op thread, so sockets need no locking and
-    collective issue order matches across ranks.
+    in the store; for each pair (i, j) with i < j, j dials i — once per
+    **lane**.  Lanes are parallel TCP connections that one logical
+    collective stripes its frames across (``_lane_parts``), curing
+    single-stream cwnd underutilization on long-RTT links; lane count MUST
+    be uniform across ranks and is verified in the hello frame.  All data
+    ops for the epoch run on a single op thread, so sockets need no locking
+    and collective issue order matches across ranks; one select loop
+    multiplexes every lane.
+
+    Point-to-point byte ops (sends/recvs, heal drains) ride the LAST lane
+    (``p2p_lane``) whole — with lanes > 1 that keeps striped heal traffic
+    off lane 0, where collective control frames (barriers, small rings)
+    concentrate; with lanes == 1 it is byte-for-byte the legacy behavior.
     """
 
     def __init__(
@@ -290,17 +489,31 @@ class _TcpMesh:
         rank: int,
         world_size: int,
         timeout_s: float,
+        lanes: int = 0,
     ) -> None:
         self.rank = rank
         self.world_size = world_size
         self._aborted = threading.Event()
-        self.peers: Dict[int, socket.socket] = {}
-        # netem-style pacing (off unless TORCHFT_NET_GBPS/RTT_MS set)
+        # netem-style pacing (off unless TORCHFT_NET_EMU/GBPS/RTT_MS set)
         self._emu = _net_emu_from_env()
+        self.lanes = lanes if lanes > 0 else _ring_lanes(self._emu)
+        self.p2p_lane = self.lanes - 1
+        self.stripe_floor = _stripe_floor(self._emu)
+        # lane-0 sockets keep the legacy name: single-lane code paths (and
+        # tests) address peers through it unchanged
+        self.peers: Dict[int, socket.socket] = {}
+        self.lane_socks: Dict[Tuple[int, int], socket.socket] = {}
+        self._sock_key: Dict[socket.socket, Tuple[int, int]] = {}
+        # per-lane observability: payload bytes moved and stall events
+        # (pacer denials / kernel would-block) — surfaced via
+        # TCPCommunicator.lane_stats() into manager.last_quorum_timings
+        self.lane_tx_bytes = [0] * self.lanes
+        self.lane_rx_bytes = [0] * self.lanes
+        self.lane_stalls = [0] * self.lanes
 
         store = create_store_client(store_addr, timeout=timeout_s)
 
-        listener = create_listener("0.0.0.0:0", backlog=world_size)
+        listener = create_listener("0.0.0.0:0", backlog=world_size * self.lanes)
         port = listener.getsockname()[1]
         host = socket.gethostname()
         try:
@@ -310,8 +523,8 @@ class _TcpMesh:
             host = "127.0.0.1"
         store.set(f"{rank}", f"{host}:{port}".encode())
 
-        expected_inbound = world_size - rank - 1
-        inbound: Dict[int, socket.socket] = {}
+        expected_inbound = (world_size - rank - 1) * self.lanes
+        inbound: Dict[Tuple[int, int], socket.socket] = {}
         accept_err: List[BaseException] = []
 
         def _accept_all() -> None:
@@ -320,10 +533,40 @@ class _TcpMesh:
                 for _ in range(expected_inbound):
                     conn, _ = listener.accept()
                     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    # hello frame: peer's rank
                     raw = _recv_exact(conn, 8, self._aborted, timeout_s)
-                    (peer_rank,) = struct.unpack("<Q", raw)
-                    inbound[int(peer_rank)] = conn
+                    (first,) = struct.unpack("<Q", raw)
+                    if not first & _LANE_HELLO_FLAG:
+                        # legacy 8-byte hello: a single-lane peer.  A lane
+                        # disagreement is a config error — fail LOUDLY here
+                        # instead of desynchronizing frames mid-collective.
+                        if self.lanes != 1:
+                            raise CommunicatorError(
+                                f"lane-count mismatch: rank {first} has 1 "
+                                f"lane, we have {self.lanes} "
+                                f"({RING_LANES_ENV} must be uniform)"
+                            )
+                        inbound[(int(first), 0)] = conn
+                        continue
+                    peer_rank = int(first & ~_LANE_HELLO_FLAG)
+                    tail = _recv_exact(conn, 24, self._aborted, timeout_s)
+                    lane, peer_lanes, peer_floor = struct.unpack("<QQQ", tail)
+                    if int(peer_lanes) != self.lanes:
+                        raise CommunicatorError(
+                            f"lane-count mismatch: rank {peer_rank} has "
+                            f"{peer_lanes} lanes, we have {self.lanes} "
+                            f"({RING_LANES_ENV} must be uniform)"
+                        )
+                    if int(peer_floor) != self.stripe_floor:
+                        # the floor shapes the deterministic sub-frame
+                        # split — a disagreement would desynchronize every
+                        # striped frame
+                        raise CommunicatorError(
+                            f"stripe-floor mismatch: rank {peer_rank} has "
+                            f"{peer_floor} bytes, we have "
+                            f"{self.stripe_floor} ({RING_FRAME_KB_ENV} / "
+                            "the net-emu profile must be uniform)"
+                        )
+                    inbound[(peer_rank, int(lane))] = conn
             except BaseException as e:  # noqa: BLE001
                 accept_err.append(e)
 
@@ -334,12 +577,25 @@ class _TcpMesh:
             for peer in range(rank):
                 addr = store.get(f"{peer}", timeout=timeout_s).decode()
                 peer_host, peer_port = addr.rsplit(":", 1)
-                sock = socket.create_connection(
-                    (peer_host.strip("[]"), int(peer_port)), timeout=timeout_s
-                )
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                sock.sendall(struct.pack("<Q", rank))
-                self.peers[peer] = sock
+                for lane in range(self.lanes):
+                    sock = socket.create_connection(
+                        (peer_host.strip("[]"), int(peer_port)),
+                        timeout=timeout_s,
+                    )
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    if self.lanes == 1:
+                        sock.sendall(struct.pack("<Q", rank))
+                    else:
+                        sock.sendall(
+                            struct.pack(
+                                "<QQQQ",
+                                rank | _LANE_HELLO_FLAG,
+                                lane,
+                                self.lanes,
+                                self.stripe_floor,
+                            )
+                        )
+                    self.lane_socks[(peer, lane)] = sock
 
             acceptor.join(timeout=timeout_s + 5.0)
             if accept_err:
@@ -348,18 +604,31 @@ class _TcpMesh:
                 ) from accept_err[0]
             if acceptor.is_alive():
                 raise CommunicatorError(f"rank {rank} rendezvous timed out")
-            self.peers.update(inbound)
+            self.lane_socks.update(inbound)
         finally:
             listener.close()
 
-        for sock in self.peers.values():
+        for (peer, lane), sock in self.lane_socks.items():
             sock.setblocking(False)
+            self._sock_key[sock] = (peer, lane)
+            if lane == 0:
+                self.peers[peer] = sock
+
+    # -- lane lookups --------------------------------------------------------
+
+    def lane_sock(self, peer: int, lane: int) -> socket.socket:
+        return self.lane_socks[(peer, lane)]
+
+    def p2p_sock(self, peer: int) -> socket.socket:
+        """The designated point-to-point lane socket (last lane; the one and
+        only socket at lanes == 1)."""
+        return self.lane_socks[(peer, self.p2p_lane)]
 
     # -- low-level duplex IO -------------------------------------------------
 
     def abort(self) -> None:
         self._aborted.set()
-        for sock in self.peers.values():
+        for sock in self.lane_socks.values():
             try:
                 sock.close()
             except OSError:
@@ -374,7 +643,7 @@ class _TcpMesh:
     ) -> int:
         """Header-aware zero-copy receive: payload lands in ``view`` (cap
         semantics — payload may be smaller); returns the payload size."""
-        sock = self.peers[src]
+        sock = self.p2p_sock(src)
 
         def _recv_some(into: memoryview) -> int:
             while True:
@@ -419,7 +688,7 @@ class _TcpMesh:
     def recv_dynamic(self, src: int, tag: int, deadline: float) -> bytes:
         """Receive one frame from ``src`` without knowing its size upfront —
         the frame header carries nbytes, so this pairs with any plain send."""
-        sock = self.peers[src]
+        sock = self.p2p_sock(src)
 
         def _recv_some(view: memoryview) -> int:
             while True:
@@ -455,116 +724,186 @@ class _TcpMesh:
     def exchange(
         self,
         sends: List[Tuple[int, int, memoryview]],
-        recvs: List[Tuple[int, int, memoryview]],
+        recvs: Sequence[Tuple],
         deadline: float,
+        lane: Optional[int] = None,
     ) -> None:
         """Concurrently push ``sends`` and drain ``recvs``.
 
-        ``sends``/``recvs`` are ``(peer_rank, tag, payload_view)`` triples.
-        Concurrent duplex IO (select-driven, non-blocking sockets) is what
-        makes ring steps deadlock-free: every rank sends to its right
-        neighbor while receiving from its left without ordering constraints.
-        """
-        send_state = {}
-        frame_gates: Dict[int, float] = {}
-        for peer, tag, view in sends:
-            header = _HDR.pack(len(view), tag)
-            send_state[peer] = [memoryview(header), view]
-            if self._emu is not None:
-                # half-RTT before the frame's first byte leaves
-                frame_gates[peer] = self._emu.frame_gate()
-        recv_state = {}
-        for peer, tag, view in recvs:
-            recv_state[peer] = {
-                "hdr": bytearray(),
-                "view": view,
-                "off": 0,
-                "tag": tag,
-            }
+        ``sends`` entries are ``(peer_rank, tag, payload_view)``; ``recvs``
+        entries additionally accept an optional 4th element — an
+        ``on_part(start, stop)`` callable invoked (on the op thread) as each
+        completed byte range of the payload lands, which is what lets the
+        ring reduce a lane's sub-chunk while the other lanes still stream.
 
-        while send_state or recv_state:
+        With ``lane=None`` every frame is striped across the mesh's lanes
+        by the deterministic ``_lane_parts`` split (both endpoints compute
+        the identical split from the frame length, and sub-frame boundaries
+        are element-aligned, so results are bit-identical at any lane
+        count); pass an explicit ``lane`` to pin a whole frame to one
+        connection (the point-to-point path).
+
+        Concurrent duplex IO (select-driven, non-blocking sockets, one loop
+        multiplexing all lanes) is what makes ring steps deadlock-free:
+        every rank sends to its right neighbor while receiving from its
+        left without ordering constraints.
+        """
+        emu = self._emu
+
+        def _parts(nbytes: int) -> List[Tuple[int, int, int]]:
+            if lane is not None:
+                return [(lane, 0, nbytes)]
+            return _lane_parts(nbytes, self.lanes, self.stripe_floor)
+
+        # per-socket FIFO of outgoing sub-frames; each frame is a list of
+        # pending buffers (header, then payload) so a socket carries its
+        # sub-frames strictly in order
+        send_q: Dict[Tuple[int, int], List[List[memoryview]]] = {}
+        for peer, tag, view in sends:
+            for ln, start, stop in _parts(len(view)):
+                header = _HDR.pack(stop - start, tag)
+                frame = [memoryview(header)]
+                if stop > start:
+                    frame.append(view[start:stop])
+                send_q.setdefault((peer, ln), []).append(frame)
+        # per-socket FIFO of expected sub-frames
+        recv_q: Dict[Tuple[int, int], List[dict]] = {}
+        for entry in recvs:
+            peer, tag, view = entry[0], entry[1], entry[2]
+            on_part = entry[3] if len(entry) > 3 else None
+            for ln, start, stop in _parts(len(view)):
+                recv_q.setdefault((peer, ln), []).append(
+                    {
+                        "hdr": bytearray(),
+                        "view": view[start:stop],
+                        "off": 0,
+                        "tag": tag,
+                        "start": start,
+                        "stop": stop,
+                        "on_part": on_part,
+                    }
+                )
+
+        frame_gates: Dict[Tuple[int, int], float] = {}
+        if emu is not None:
+            for key in send_q:
+                # half-RTT before the first frame's first byte leaves; the
+                # gate re-arms as each subsequent frame reaches the head
+                frame_gates[key] = emu.frame_gate()
+
+        while send_q or recv_q:
             self._check_abort()
             if time.monotonic() > deadline:
                 raise TimeoutError("collective exchange timed out")
-            rlist = [self.peers[p] for p in recv_state]
-            wlist = [self.peers[p] for p in send_state]
+            rlist = [self.lane_socks[k] for k in recv_q]
+            wlist = [self.lane_socks[k] for k in send_q]
             readable, writable, _ = select.select(rlist, wlist, [], 0.1)
 
             paced_block = False
             for sock in writable:
-                peer = next(p for p, s in self.peers.items() if s is sock)
-                bufs = send_state.get(peer)
-                if bufs is None:
+                key = self._sock_key[sock]
+                frames = send_q.get(key)
+                if frames is None:
                     continue
-                if self._emu is not None and time.monotonic() < frame_gates.get(
-                    peer, 0.0
+                ln = key[1]
+                if emu is not None and time.monotonic() < frame_gates.get(
+                    key, 0.0
                 ):
                     paced_block = True
+                    self.lane_stalls[ln] += 1
                     continue
                 try:
-                    while bufs:
+                    while frames:
+                        bufs = frames[0]
+                        # len 0 = a zero-payload frame's body (e.g. the
+                        # empty ring chunk at ws=2): nothing to pace
+                        while bufs and len(bufs[0]) == 0:
+                            bufs.pop(0)
+                        if not bufs:
+                            frames.pop(0)
+                            if frames and emu is not None:
+                                frame_gates[key] = emu.frame_gate()
+                                break
+                            continue
                         chunk = bufs[0]
-                        # len 0 = a zero-payload frame's body (e.g. the empty
-                        # ring chunk at ws=2): nothing to pace — send() pops it
-                        if self._emu is not None and len(chunk) > 0:
-                            allowed = self._emu.allow(len(chunk))
+                        if emu is not None:
+                            allowed = emu.allow(len(chunk), stream=key)
                             if allowed <= 0:
                                 paced_block = True
+                                self.lane_stalls[ln] += 1
                                 break
                             chunk = chunk[:allowed]
                         sent = sock.send(chunk)
-                        if self._emu is not None:
-                            self._emu.consume(sent)
+                        if emu is not None:
+                            emu.consume(sent, stream=key)
+                        self.lane_tx_bytes[ln] += sent
                         if sent == len(bufs[0]):
                             bufs.pop(0)
                         else:
                             bufs[0] = bufs[0][sent:]
                             break
                 except BlockingIOError:
-                    pass
+                    self.lane_stalls[ln] += 1
                 except OSError as e:
-                    raise PeerGoneError(f"send to rank {peer} failed: {e}") from e
-                if not bufs:
-                    del send_state[peer]
+                    raise PeerGoneError(
+                        f"send to rank {key[0]} failed: {e}"
+                    ) from e
+                if frames is not None and not any(frames):
+                    del send_q[key]
 
             for sock in readable:
-                peer = next(p for p, s in self.peers.items() if s is sock)
-                st = recv_state.get(peer)
-                if st is None:
+                key = self._sock_key[sock]
+                queue_ = recv_q.get(key)
+                if not queue_:
                     continue
+                peer, ln = key
+                # drain the socket fully per readiness event (sub-frames
+                # arrive back to back): one recv per select round would
+                # multiply the syscall count and cap the aggregate rate
                 try:
-                    if len(st["hdr"]) < _HDR.size:
-                        chunk = sock.recv(_HDR.size - len(st["hdr"]))
-                        if not chunk:
-                            raise PeerGoneError(
-                                f"connection to rank {peer} closed"
-                            )
-                        st["hdr"] += chunk
-                        if len(st["hdr"]) == _HDR.size:
-                            nbytes, tag = _HDR.unpack(bytes(st["hdr"]))
-                            if tag != st["tag"]:
-                                raise CommunicatorError(
-                                    f"tag mismatch from rank {peer}: "
-                                    f"got {tag}, want {st['tag']}"
+                    while queue_:
+                        st = queue_[0]
+                        if len(st["hdr"]) < _HDR.size:
+                            chunk = sock.recv(_HDR.size - len(st["hdr"]))
+                            if not chunk:
+                                raise PeerGoneError(
+                                    f"connection to rank {peer} closed"
                                 )
-                            if nbytes != len(st["view"]):
-                                raise CommunicatorError(
-                                    f"size mismatch from rank {peer}: "
-                                    f"got {nbytes}, want {len(st['view'])}"
+                            st["hdr"] += chunk
+                            if len(st["hdr"]) == _HDR.size:
+                                nbytes, tag = _HDR.unpack(bytes(st["hdr"]))
+                                if tag != st["tag"]:
+                                    raise CommunicatorError(
+                                        f"tag mismatch from rank {peer}: "
+                                        f"got {tag}, want {st['tag']}"
+                                    )
+                                if nbytes != len(st["view"]):
+                                    raise CommunicatorError(
+                                        f"size mismatch from rank {peer}: "
+                                        f"got {nbytes}, want "
+                                        f"{len(st['view'])} (lane {ln})"
+                                    )
+                        elif st["off"] < len(st["view"]):
+                            n = sock.recv_into(st["view"][st["off"] :])
+                            if n == 0:
+                                raise PeerGoneError(
+                                    f"connection to rank {peer} closed"
                                 )
-                    elif st["off"] < len(st["view"]):
-                        n = sock.recv_into(st["view"][st["off"] :])
-                        if n == 0:
-                            raise PeerGoneError(
-                                f"connection to rank {peer} closed"
-                            )
-                        st["off"] += n
+                            st["off"] += n
+                            self.lane_rx_bytes[ln] += n
+                        # complete once the header arrived and the payload
+                        # (possibly zero-length) is fully received
+                        if (
+                            len(st["hdr"]) == _HDR.size
+                            and st["off"] == len(st["view"])
+                        ):
+                            queue_.pop(0)
+                            if st["on_part"] is not None:
+                                st["on_part"](st["start"], st["stop"])
                 except BlockingIOError:
-                    continue
-                # complete once the header arrived and the payload (possibly
-                # zero-length) is fully received
-                if len(st["hdr"]) == _HDR.size and st["off"] == len(st["view"]):
-                    del recv_state[peer]
+                    pass
+                if not queue_:
+                    del recv_q[key]
 
             if paced_block:
                 # socket writable but the pacer denied bytes — select would
@@ -609,6 +948,12 @@ class _TcpMesh:
         for lst in expected.values():
             needed.update(lst)
         queues: Dict[int, List[int]] = {p: list(lst) for p, lst in expected.items()}
+        # heal frames ride the designated p2p lane (the last lane): with
+        # lanes > 1 a heal no longer contends with lane 0, where the
+        # collective epoch's control frames concentrate; with lanes == 1
+        # this is exactly the legacy single-socket behavior
+        socks: Dict[int, socket.socket] = {p: self.p2p_sock(p) for p in queues}
+        sock_peer: Dict[socket.socket, int] = {s: p for p, s in socks.items()}
         pending_ctrl: Dict[int, List[memoryview]] = {p: [] for p in queues}
         frame_gates: Dict[int, float] = {}
         recv_st: Dict[int, Optional[dict]] = {p: None for p in queues}
@@ -646,7 +991,7 @@ class _TcpMesh:
                 # desynchronized but the socket is alive — close it so later
                 # ops fail cleanly instead of misparsing garbage frames
                 try:
-                    self.peers[p].close()
+                    socks[p].close()
                 except OSError:
                     pass
             logger.warning(
@@ -657,7 +1002,7 @@ class _TcpMesh:
         def _flush_writes(wlist_socks: List[socket.socket]) -> bool:
             paced = False
             for sock in wlist_socks:
-                p = next(q for q, s in self.peers.items() if s is sock)
+                p = sock_peer[sock]
                 bufs = pending_ctrl.get(p)
                 if not bufs or p in dead:
                     continue
@@ -670,14 +1015,16 @@ class _TcpMesh:
                     while bufs:
                         chunk_b = bufs[0]
                         if self._emu is not None and len(chunk_b) > 0:
-                            allowed = self._emu.allow(len(chunk_b))
+                            allowed = self._emu.allow(
+                                len(chunk_b), stream=(p, self.p2p_lane)
+                            )
                             if allowed <= 0:
                                 paced = True
                                 break
                             chunk_b = chunk_b[:allowed]
                         sent = sock.send(chunk_b)
                         if self._emu is not None:
-                            self._emu.consume(sent)
+                            self._emu.consume(sent, stream=(p, self.p2p_lane))
                         if sent == len(bufs[0]):
                             bufs.pop(0)
                             frame_gates.pop(p, None)
@@ -703,15 +1050,15 @@ class _TcpMesh:
                     f"all heal sources died with "
                     f"{len(needed) - len(received)} chunks outstanding: {first}"
                 )
-            rlist = [self.peers[p] for p in alive if queues[p]]
-            wlist = [self.peers[p] for p in alive if pending_ctrl[p]]
+            rlist = [socks[p] for p in alive if queues[p]]
+            wlist = [socks[p] for p in alive if pending_ctrl[p]]
             if not rlist and not wlist:
                 time.sleep(0.001)  # only orphan bookkeeping left; rare
                 continue
             readable, writable, _ = select.select(rlist, wlist, [], 0.1)
             paced_block = _flush_writes(writable)
             for sock in readable:
-                p = next(q for q, s in self.peers.items() if s is sock)
+                p = sock_peer[sock]
                 # drain the socket fully per readiness event (frames arrive
                 # back to back): one recv per select round would double the
                 # syscall count and cap the aggregate drain rate
@@ -781,7 +1128,7 @@ class _TcpMesh:
         ) and time.monotonic() < flush_deadline:
             self._check_abort()
             wlist = [
-                self.peers[p]
+                socks[p]
                 for p in queues
                 if p not in dead and pending_ctrl[p]
             ]
@@ -822,6 +1169,13 @@ class TCPCommunicator(Communicator):
     bandwidth-optimal ring reduce-scatter + allgather on numpy buffers, all
     ops serialized on a per-epoch op thread, per-op userspace timeouts that
     ``abort()`` the communicator on expiry.
+
+    Ring collectives stripe every frame across ``TORCHFT_RING_LANES``
+    parallel connections per peer (``_TcpMesh``/``_lane_parts``) — the cure
+    for cwnd-limited single TCP streams on long-RTT DCN links — with
+    bit-identical results at any lane count and the same epoch/abort
+    semantics (peer death on any lane latches the epoch error exactly
+    once).
     """
 
     def __init__(self, timeout_s: float = 60.0) -> None:
@@ -935,6 +1289,22 @@ class TCPCommunicator(Communicator):
 
     def set_timeout(self, timeout_s: float) -> None:
         self._timeout_s = timeout_s
+
+    def lane_stats(self) -> Dict[str, object]:
+        """Per-lane observability of the current epoch's mesh: lane count,
+        payload bytes sent/received per lane, and stall events (pacer
+        denials / kernel would-block) per lane.  Empty when unconfigured or
+        single-member."""
+        mesh = self._mesh
+        if mesh is None:
+            return {}
+        return {
+            "lanes": mesh.lanes,
+            "stripe_floor_bytes": mesh.stripe_floor,
+            "lane_tx_bytes": list(mesh.lane_tx_bytes),
+            "lane_rx_bytes": list(mesh.lane_rx_bytes),
+            "lane_stalls": list(mesh.lane_stalls),
+        }
 
     # -- op submission -------------------------------------------------------
 
@@ -1108,7 +1478,11 @@ class TCPCommunicator(Communicator):
         def _make(ctx: "_CommCtx") -> Callable[[], object]:
             def _run() -> object:
                 mesh = ctx.require_peer(dst)
-                mesh.exchange([(dst, tag, view)], [], ctx.deadline())
+                # whole frame on the designated p2p lane: the receive paths
+                # (recv_dynamic*/striped_drain) read that one socket
+                mesh.exchange(
+                    [(dst, tag, view)], [], ctx.deadline(), lane=mesh.p2p_lane
+                )
                 return view.nbytes
 
             return _run
@@ -1355,17 +1729,29 @@ def _ring_reduce_scatter(
         return flat[bounds[i] : bounds[i + 1]]
 
     scratch = np.empty(bounds[1], dtype=flat.dtype)
+    itemsize = flat.dtype.itemsize
     for step in range(ws - 1):
         send_idx = (rank - step - 1) % ws
         recv_idx = (rank - step - 2) % ws
         send_chunk = chunk(send_idx)
-        recv_buf = scratch[: chunk(recv_idx).size]
+        recv_chunk = chunk(recv_idx)
+        recv_buf = scratch[: recv_chunk.size]
+
+        # reduce each completed lane sub-range as it lands, while the other
+        # lanes are still streaming — sub-frame boundaries are 64-byte
+        # aligned so element ranges never split, and every element still
+        # sees exactly one add per step: bit-identical at any lane count
+        def _reduce_part(
+            start: int, stop: int, _dst=recv_chunk, _src=recv_buf
+        ) -> None:
+            lo, hi = start // itemsize, stop // itemsize
+            _reduce_into(op, _dst[lo:hi], _src[lo:hi])
+
         mesh.exchange(
             [(right, tag_base + 1000 + step, _bytes_view(send_chunk))],
-            [(left, tag_base + 1000 + step, _bytes_view(recv_buf))],
+            [(left, tag_base + 1000 + step, _bytes_view(recv_buf), _reduce_part)],
             deadline,
         )
-        _reduce_into(op, chunk(recv_idx), recv_buf)
     return chunk(rank)
 
 
@@ -1376,7 +1762,10 @@ def _ring_allreduce(
 
     Reduce-scatter then allgather, ws-1 steps each; every step exchanges one
     chunk with both neighbors concurrently via duplex IO (deadlock-free even
-    at world size 2, where both directions share one socket).
+    at world size 2, where both directions share one socket pair).  Each
+    chunk's frame is lane-striped by ``exchange``; the per-element reduction
+    order is fixed by the chunk schedule alone, so lane count never changes
+    the bits.
     """
     mesh = ctx.mesh
     assert mesh is not None
@@ -1563,6 +1952,9 @@ class FakeCommunicatorWrapper(Communicator):
     def allgather(self, data, tag: int = 0) -> Work:
         return self._wrap(self._comm.allgather(data, tag))
 
+    def lane_stats(self) -> Dict[str, object]:
+        return self._comm.lane_stats()
+
     def barrier(self) -> Work:
         return self._wrap(self._comm.barrier())
 
@@ -1625,6 +2017,9 @@ class ManagedCommunicator(Communicator):
 
     def heal_drain(self, *args, **kwargs) -> Work:
         return self._manager._comm.heal_drain(*args, **kwargs)
+
+    def lane_stats(self) -> Dict[str, object]:
+        return self._manager._comm.lane_stats()
 
     def barrier(self) -> Work:
         return self._manager._comm.barrier()

@@ -11,6 +11,7 @@ shardings.  Compiled programs never see the replica count (SURVEY.md §7).
 
 from __future__ import annotations
 
+import functools
 import os
 import threading
 from concurrent.futures import Future
@@ -32,14 +33,17 @@ from torchft_tpu.work import DummyWork, Work
 # MUST be uniform across replicas: bucket boundaries shape the collective
 # sequence (mismatches fail fast via the ring's frame-size validation, like
 # the reference's frozen DDP bucket layout requirement, ``ddp.py:46-62``).
-# Parsed once at import so it cannot drift within a process; malformed values
-# fall back to the default rather than raising into the train loop.
+# The env is read per call with the parse memoized on the raw string: the
+# same raw value always yields the same cap (uniform within a process AND
+# across replicas that agree on the env), while tests can flip the env to
+# exercise bucket boundaries without re-importing the module.  Malformed
+# values fall back to the default rather than raising into the train loop.
 BUCKET_CAP_MB_ENV = "TORCHFT_BUCKET_CAP_MB"
 DEFAULT_BUCKET_CAP_MB = 32
 
 
-def _parse_bucket_cap() -> int:
-    raw = os.environ.get(BUCKET_CAP_MB_ENV, "")
+@functools.lru_cache(maxsize=None)
+def _parse_bucket_cap(raw: str) -> int:
     try:
         mb = float(raw) if raw else float(DEFAULT_BUCKET_CAP_MB)
     except ValueError:
@@ -52,7 +56,8 @@ def _parse_bucket_cap() -> int:
     return max(1, int(mb * (1 << 20)))
 
 
-_BUCKET_CAP_BYTES = _parse_bucket_cap()
+def _bucket_cap_bytes() -> int:
+    return _parse_bucket_cap(os.environ.get(BUCKET_CAP_MB_ENV, ""))
 
 
 def allreduce_pytree_result(tree: Any) -> Work:
@@ -183,7 +188,7 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
     # the op thread rings bucket k while we fetch/assemble bucket k+1 —
     # transfer/communication pipelining, the reference's bucket_cap_mb
     # (``local_sgd.py:28,477-566``) in jax form.
-    bucket_cap = _BUCKET_CAP_BYTES
+    bucket_cap = _bucket_cap_bytes()
     order: Dict[str, List[int]] = {}
     leaf_bytes: List[int] = []
     for i, leaf in enumerate(leaves):

@@ -26,18 +26,15 @@ optax.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Union
 
 import jax
 import numpy as np
 
+from torchft_tpu.ddp import allreduce_pytree
 from torchft_tpu.manager import Manager
 
 logger = logging.getLogger(__name__)
-
-
-def _to_host(leaves: Sequence[Any]) -> List[np.ndarray]:
-    return [np.asarray(leaf) for leaf in leaves]
 
 
 def _like_leaf(value: np.ndarray, ref: Any) -> Any:
@@ -114,21 +111,21 @@ class LocalSGD:
 
     def sync(self) -> bool:
         """Average parameters across replicas and commit
-        (``local_sgd.py:129-172``)."""
+        (``local_sgd.py:129-172``).
+
+        Routed through ``ddp.allreduce_pytree``'s bucketed pipeline — the
+        same path DiLoCo fragments ride: device→host copies start
+        asynchronously up front (``copy_to_host_async``) and overlap bucket
+        assembly, each bucket's ring runs while the next bucket stages, and
+        the rings reduce ``in_place`` in the staging buffers (the live
+        params are never aliased).  The old path shipped the whole model as
+        one blocking collective with synchronous host copies."""
         self._manager.start_quorum()
-        params = self._holder["params"]
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        host = _to_host(leaves)
-        work = self._manager.allreduce(host)
+        work = allreduce_pytree(self._manager, self._holder["params"])
         averaged = work.wait()
         committed = self._manager.should_commit()
         if committed:
-            new_leaves = [
-                _like_leaf(avg, leaf) for avg, leaf in zip(averaged, leaves)
-            ]
-            self._holder["params"] = jax.tree_util.tree_unflatten(
-                treedef, new_leaves
-            )
+            self._holder["params"] = averaged
         return committed
 
 

@@ -473,16 +473,27 @@ class Manager:
                 self._participating_replica_rank = None
 
         if quorum_id != self._quorum_id:
-            self.quorum_logger.info(
-                "",
-                extra={
-                    "job_id": os.environ.get("JOB_ID", "unknown"),
-                    "replica_id": self._replica_id,
-                    "rank": self._group_rank,
-                    "quorum_id": quorum_id,
-                    "step": max_step,
-                },
-            )
+            # lane counters of the OUTGOING epoch (bytes/stalls accumulated
+            # since its configure) ride the quorum-change event: per-lane
+            # imbalance or a stall-heavy lane is visible per epoch without
+            # any scraping of the data plane itself
+            quorum_extra = {
+                "job_id": os.environ.get("JOB_ID", "unknown"),
+                "replica_id": self._replica_id,
+                "rank": self._group_rank,
+                "quorum_id": quorum_id,
+                "step": max_step,
+            }
+            lane_stats_fn = getattr(self._comm, "lane_stats", None)
+            prev_lane_stats = lane_stats_fn() if callable(lane_stats_fn) else {}
+            if prev_lane_stats:
+                quorum_extra.update(
+                    comm_lanes=prev_lane_stats.get("lanes"),
+                    comm_lane_tx_bytes=prev_lane_stats.get("lane_tx_bytes"),
+                    comm_lane_rx_bytes=prev_lane_stats.get("lane_rx_bytes"),
+                    comm_lane_stalls=prev_lane_stats.get("lane_stalls"),
+                )
+            self.quorum_logger.info("", extra=quorum_extra)
             store_prefixed_addr = (
                 f"{quorum.store_address}/torchft/{quorum_id}/{self._group_rank}"
             )
@@ -510,6 +521,16 @@ class Manager:
                 return
             finally:
                 timings["configure_s"] = time.monotonic() - t_cfg
+            # lane layout of the fresh epoch (benches/operators read it from
+            # last_quorum_timings next to the phase wall-times)
+            fresh_lane_stats = (
+                lane_stats_fn() if callable(lane_stats_fn) else {}
+            )
+            if fresh_lane_stats.get("lanes"):
+                timings["ring_lanes"] = float(fresh_lane_stats["lanes"])
+                timings["ring_stripe_floor_bytes"] = float(
+                    fresh_lane_stats.get("stripe_floor_bytes", 0)
+                )
 
         if allow_heal:
             # The reference runs recovery on a dedicated CUDA stream

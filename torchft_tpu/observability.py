@@ -51,6 +51,12 @@ _ATTR_KEYS = (
     "step",
     "commit_result",
     "error",
+    # data-plane lane counters (torchft_quorums; per-epoch, from
+    # Communicator.lane_stats() at quorum change — multi-lane ring striping)
+    "comm_lanes",
+    "comm_lane_tx_bytes",
+    "comm_lane_rx_bytes",
+    "comm_lane_stalls",
     # heal-path counters (torchft_heals; striped checkpoint recovery)
     "heal_bytes",
     "heal_duration_s",

@@ -59,7 +59,16 @@ WIRE_COMPAT_ENV = "TORCHFT_WIRE_COMPAT"
 def manager_quorum_wire_version() -> int:
     compat = os.environ.get(WIRE_COMPAT_ENV)
     if compat:
-        return max(1, min(MANAGER_QUORUM_WIRE_VERSION, int(compat)))
+        try:
+            pinned = int(compat)
+        except ValueError as e:
+            # name the knob: a bare int() error deep in the quorum RPC path
+            # would hide which env var is at fault
+            raise ValueError(
+                f"unparseable {WIRE_COMPAT_ENV}={compat!r} (expected an "
+                f"integer wire version <= {MANAGER_QUORUM_WIRE_VERSION})"
+            ) from e
+        return max(1, min(MANAGER_QUORUM_WIRE_VERSION, pinned))
     return MANAGER_QUORUM_WIRE_VERSION
 
 
