@@ -47,11 +47,25 @@ def _make_comm(backend: str, timeout_s: float = 30.0):
 
 
 def worker(
-    rank: int, store_addr: str, backend: str, mb: int, iters: int, lanes: str
+    rank: int,
+    store_addr: str,
+    backend: str,
+    mb: int,
+    iters: int,
+    lanes: str,
+    hosts: int = 0,
 ) -> None:
     if lanes:
         # must land before configure: the mesh resolves lanes per epoch
         os.environ["TORCHFT_RING_LANES"] = lanes
+    if hosts > 0:
+        # emulated topology: partition the 2 ranks round-robin over N
+        # virtual hosts and force the hierarchical schedule — `--hosts 1`
+        # co-locates both ranks (collectives run entirely over the
+        # shared-memory segment, zero sockets), `--hosts 2` gives each its
+        # own host (leader ring == flat ring, the degenerate control)
+        os.environ["TORCHFT_HOST_ID"] = f"h{rank % hosts}"
+        os.environ["TORCHFT_HIERARCHICAL"] = "1"
     comm = _make_comm(backend)
     comm.configure(store_addr, f"bench_{rank}", rank, 2)
     nbytes = mb << 20
@@ -96,18 +110,21 @@ def worker(
 
     if rank == 1:
         lane_stats = comm.lane_stats() if hasattr(comm, "lane_stats") else {}
-        print(
-            json.dumps(
-                {
-                    "backend": backend,
-                    "mb": mb,
-                    # tiers without counters (cpp) report the requested knob
-                    # verbatim ("auto"/"" included) rather than a guess
-                    "lanes": lane_stats.get("lanes", lanes or "default"),
-                    **{k: round(v, 3) for k, v in results.items()},
-                }
-            )
-        )
+        payload = {
+            "backend": backend,
+            "mb": mb,
+            # tiers without counters (cpp) report the requested knob
+            # verbatim ("auto"/"" included) rather than a guess
+            "lanes": lane_stats.get("lanes", lanes or "default"),
+            **{k: round(v, 3) for k, v in results.items()},
+        }
+        if hosts > 0:
+            payload["hosts"] = hosts
+            payload["topo_hosts"] = lane_stats.get("topo_hosts")
+            payload["shm_bytes"] = int(
+                lane_stats.get("shm_tx_bytes", 0)
+            ) + int(lane_stats.get("shm_rx_bytes", 0))
+        print(json.dumps(payload))
     comm.shutdown()
 
 
@@ -125,13 +142,32 @@ def main() -> None:
         default="",
         help="TORCHFT_RING_LANES for both ranks (int or 'auto'; default env)",
     )
+    p.add_argument(
+        "--hosts",
+        type=int,
+        default=0,
+        help="emulated host count for the hierarchical topology (0 = flat; "
+        "1 = both ranks co-hosted over shared memory; 2 = one rank/host)",
+    )
     p.add_argument("--rank", type=int, default=-1)
     p.add_argument("--store", default="")
     args = p.parse_args()
 
+    if args.hosts > 0 and args.backend != "tcp":
+        # loud, not silent: the cpp/baby tiers ignore the topology knobs,
+        # so a "--hosts 1" row would report plain TCP as a co-hosted shm
+        # measurement
+        p.error(f"--hosts requires --backend tcp (got {args.backend!r})")
+
     if args.rank >= 0:
         worker(
-            args.rank, args.store, args.backend, args.mb, args.iters, args.lanes
+            args.rank,
+            args.store,
+            args.backend,
+            args.mb,
+            args.iters,
+            args.lanes,
+            args.hosts,
         )
         return
 
@@ -145,6 +181,7 @@ def main() -> None:
                 sys.executable, os.path.abspath(__file__),
                 "--backend", args.backend, "--mb", str(args.mb),
                 "--iters", str(args.iters), "--lanes", args.lanes,
+                "--hosts", str(args.hosts),
                 "--rank", str(r), "--store", addr,
             ]
         )

@@ -196,6 +196,116 @@ def _striped_rank_main(rank, world, port, mb, iters, gbps, rtt_ms, out_q):
         out_q.put(results)
 
 
+def _hier_host_main(proc_idx, hosts, per_host, port, mb, iters, gbps, rtt_ms, out_q):
+    """One PROCESS per emulated host, its replicas as THREADS: every rank
+    of the host shares the process's emulated NIC (the communicator's
+    process-shared link bucket), so the flat ring pays the real co-location
+    tax — ``per_host`` full payload streams squeezing through one uplink —
+    and the hierarchical schedule's once-per-host wire traffic shows up as
+    genuine link relief, not just fewer ring steps."""
+    os.environ["TORCHFT_NET_GBPS"] = str(gbps)
+    os.environ["TORCHFT_NET_RTT_MS"] = str(rtt_ms)
+    os.environ.setdefault("TORCHFT_QUANT_DEVICE_REDUCE", "0")
+    from concurrent.futures import ThreadPoolExecutor
+
+    from torchft_tpu.communicator import TCPCommunicator
+
+    world = hosts * per_host
+    n = mb * (1 << 20) // 4
+    results = {}
+    outputs = {}
+
+    def _one_rank(rank, mode, prefix):
+        rng = np.random.default_rng(rank)
+        buf = rng.normal(size=n).astype(np.float32)
+        comm = TCPCommunicator(
+            timeout_s=300.0, host_id=f"h{proc_idx}", hierarchical=mode
+        )
+        comm.configure(
+            f"127.0.0.1:{port}/{prefix}",
+            replica_id=f"r{rank}",
+            rank=rank,
+            world_size=world,
+        )
+        try:
+            out = np.asarray(comm.allreduce(buf.copy()).wait(timeout=300.0))
+            comm.barrier().wait(timeout=300.0)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                comm.allreduce(buf.copy()).wait(timeout=300.0)
+            comm.barrier().wait(timeout=300.0)
+            dt = (time.perf_counter() - t0) / iters
+            return out, dt
+        finally:
+            comm.shutdown()
+
+    for mode, label in (("0", "flat"), ("1", "hier")):
+        local_ranks = [proc_idx * per_host + t for t in range(per_host)]
+        with ThreadPoolExecutor(max_workers=per_host) as pool:
+            got = list(
+                pool.map(
+                    lambda r: _one_rank(r, mode, f"hier_{label}_{per_host}"),
+                    local_ranks,
+                )
+            )
+        if proc_idx == 0:
+            out, dt = got[0]
+            outputs[label] = out
+            results[f"allreduce_{label}_{per_host}perhost_s"] = dt
+
+    if proc_idx == 0:
+        # in-bench numeric-equivalence gate: the hierarchical schedule
+        # reduces in a different (fixed) order — allclose, never silently
+        # divergent values riding a throughput win
+        flat, hier = outputs["flat"], outputs["hier"]
+        assert np.allclose(flat, hier, rtol=1e-4, atol=1e-3), (
+            "hierarchical allreduce diverged from flat ring: "
+            f"max abs diff {np.max(np.abs(flat - hier))}"
+        )
+        out_q.put(results)
+
+
+def run_hier_profile(name, gbps, rtt_ms, mb, iters, per_host, hosts=2):
+    """Hierarchical-vs-flat allreduce rows at an emulated ``hosts`` x
+    ``per_host`` topology (one process per host, replicas as threads)."""
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer("127.0.0.1:0")
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_hier_host_main,
+            args=(p, hosts, per_host, store.port, mb, iters, gbps, rtt_ms, out_q),
+        )
+        for p in range(hosts)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        res = out_q.get(timeout=1800)
+        for p in procs:
+            p.join(timeout=120)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+        store.shutdown()
+    payload = mb * (1 << 20)
+    for label in ("flat", "hier"):
+        key = f"allreduce_{label}_{per_host}perhost_s"
+        res[f"allreduce_{label}_{per_host}perhost_GBps"] = round(
+            payload / res[key] / 1e9, 3
+        )
+    res[f"hier_{per_host}perhost_speedup"] = round(
+        res[f"allreduce_flat_{per_host}perhost_s"]
+        / res[f"allreduce_hier_{per_host}perhost_s"],
+        3,
+    )
+    return {k: (round(v, 4) if isinstance(v, float) else v) for k, v in res.items()}
+
+
 def run_profile(name, gbps, rtt_ms, mb, iters):
     from torchft_tpu.store import StoreServer
 
@@ -289,6 +399,8 @@ def main():
                     help="print a markdown table row block for RESULTS.md")
     ap.add_argument("--no-striped", action="store_true",
                     help="skip the 3-replica striped-heal phase")
+    ap.add_argument("--no-hier", action="store_true",
+                    help="skip the hierarchical 2-host topology sweep")
     args = ap.parse_args()
 
     rows = []
@@ -296,6 +408,15 @@ def main():
         row = run_profile(name, gbps, rtt, args.mb, args.iters)
         if not args.no_striped:
             row.update(run_striped_profile(name, gbps, rtt, args.mb, args.iters))
+        if not args.no_hier and name.startswith("wan_1g"):
+            # topology sweep at the constrained profile only: on loopback
+            # the flat ring already saturates and hierarchy buys nothing
+            for per_host in (2, 4):
+                row.update(
+                    run_hier_profile(
+                        name, gbps, rtt, args.mb, args.iters, per_host
+                    )
+                )
         print(json.dumps(row), flush=True)
         rows.append(row)
 
@@ -337,6 +458,21 @@ def main():
                 f"| {r['allreduce_4lane_GBps']} GB/s "
                 f"| **{r['allreduce_4lane_speedup']}x** |"
             )
+        print()
+        print(
+            "| profile | topology | flat ring | hierarchical | speedup |"
+        )
+        print("|---|---|---|---|---|")
+        for r in rows:
+            for per_host in (2, 4):
+                if f"allreduce_hier_{per_host}perhost_GBps" not in r:
+                    continue
+                print(
+                    f"| {r['profile']} | 2 hosts x {per_host}/host "
+                    f"| {r[f'allreduce_flat_{per_host}perhost_GBps']} GB/s "
+                    f"| {r[f'allreduce_hier_{per_host}perhost_GBps']} GB/s "
+                    f"| **{r[f'hier_{per_host}perhost_speedup']}x** |"
+                )
 
 
 if __name__ == "__main__":

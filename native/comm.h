@@ -15,6 +15,7 @@
 #include <fcntl.h>
 #include <sys/uio.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cstring>
@@ -152,6 +153,66 @@ inline size_t stripe_floor_from_env() {
   size_t b = static_cast<size_t>(kb * 1024);
   return b < 64 ? 64 : b;
 }
+
+// --- hierarchical topology (leader ring) ------------------------------------
+//
+// Mirror of the Python tier's host grouping (communicator.py _HostTopology)
+// so the tiers agree on the hierarchical WIRE SCHEDULE: hosts are ordered
+// by their SMALLEST global rank, each host's leader IS that rank, and
+// cross-host collectives run over the leader ring in that order (ring
+// position replaces rank in the chunk schedule — see the `ring` parameter
+// of ring_reduce_phase / ring_allgather_phase).  The shared-memory
+// intra-host hop is host-local and never crosses tiers.  NOTE: this tier's
+// configure() does not yet publish `topo_{rank}` keys, so a native rank in
+// a group makes the Python ranks' "auto" fall back to the flat ring (and a
+// forced TORCHFT_HIERARCHICAL=1 fail loudly); these helpers pin the math a
+// full native topology integration must reproduce byte-for-byte.
+
+// TORCHFT_HIERARCHICAL: "auto" (default) | "0" | "1" — must be uniform
+// across replicas, like TORCHFT_RING_LANES.
+inline std::string hierarchical_mode_from_env() {
+  const char* v = std::getenv("TORCHFT_HIERARCHICAL");
+  std::string s = v ? v : "auto";
+  if (s.empty() || s == "auto") return "auto";
+  if (s == "1" || s == "true" || s == "on") return "1";
+  if (s == "0" || s == "false" || s == "off") return "0";
+  throw CommError("unparseable TORCHFT_HIERARCHICAL=" + s + " (auto|0|1)");
+}
+
+// TORCHFT_HOST_ID overrides the host identity (default: the advertised
+// rendezvous address' host part — same-IP grouping).
+inline std::string host_id_from_env(const std::string& fallback) {
+  const char* v = std::getenv("TORCHFT_HOST_ID");
+  return (v && *v) ? std::string(v) : fallback;
+}
+
+struct HostTopology {
+  std::vector<std::vector<int64_t>> hosts;  // ordered by min global rank
+  std::vector<int64_t> leader_ring;         // hosts[i][0] for each host
+
+  // identical grouping math to the Python tier: ranks ascend within a
+  // host, hosts order by their first (smallest) rank
+  static HostTopology build(const std::map<int64_t, std::string>& host_of) {
+    std::map<std::string, std::vector<int64_t>> groups;
+    for (const auto& kv : host_of) groups[kv.second].push_back(kv.first);
+    HostTopology t;
+    for (const auto& kv : groups) t.hosts.push_back(kv.second);
+    std::sort(t.hosts.begin(), t.hosts.end(),
+              [](const std::vector<int64_t>& a, const std::vector<int64_t>& b) {
+                return a.front() < b.front();
+              });
+    for (const auto& g : t.hosts) t.leader_ring.push_back(g.front());
+    return t;
+  }
+
+  // the "auto" criterion, mirrored: >= 2 hosts AND a multi-member host
+  bool worth_it() const {
+    if (hosts.size() < 2) return false;
+    for (const auto& g : hosts)
+      if (g.size() > 1) return true;
+    return false;
+  }
+};
 
 // High bit of the hello's rank field marks the extended (multi-lane) hello:
 // (rank|flag, lane, lane count, stripe floor).  Must match the Python
@@ -372,16 +433,26 @@ class Communicator {
 
   // In-place ring allreduce over a contiguous buffer.
   void allreduce(void* data, size_t nbytes, DType dt, RedOp op) {
-    if (world_size_ <= 1) return;
+    allreduce_ring(data, nbytes, dt, op, full_ring());
+  }
+
+  // Ring allreduce over a RANK SUBSET (global ranks in ring order) — the
+  // hierarchical leader ring.  Ring position replaces rank in the chunk
+  // schedule; the full ring compiles to the identical legacy schedule
+  // (position == rank), and the Python tier's `ring=` parameter speaks the
+  // same frames, so mixed-tier leader rings interoperate.
+  void allreduce_ring(void* data, size_t nbytes, DType dt, RedOp op,
+                      const std::vector<int64_t>& ring) {
+    if (ring.size() <= 1) return;
     size_t esz = dtype_size(dt);
     auto deadline = deadline_in(timeout_s_);
-    auto bounds = ring_bounds(nbytes / esz);
+    auto bounds = ring_bounds(nbytes / esz, ring.size());
     uint8_t* bytes = static_cast<uint8_t*>(data);
 
-    // reduce-scatter phase with shift 0: rank ends owning chunk rank+1
-    ring_reduce_phase(bytes, bounds, esz, dt, op, /*shift=*/0, deadline);
+    // reduce-scatter phase with shift 0: position ends owning chunk pos+1
+    ring_reduce_phase(bytes, bounds, esz, dt, op, /*shift=*/0, deadline, ring);
     // allgather phase with matching shift: first step sends the owned chunk
-    ring_allgather_phase(bytes, bounds, esz, /*shift=*/0, deadline);
+    ring_allgather_phase(bytes, bounds, esz, /*shift=*/0, deadline, ring);
   }
 
   // reduce-scatter: `data` is reduced in place ring-wise; this rank's chunk
@@ -399,7 +470,8 @@ class Communicator {
     if (world_size_ > 1) {
       auto deadline = deadline_in(timeout_s_);
       // shift -1: rank ends owning chunk `rank` (conventional contract)
-      ring_reduce_phase(bytes, bounds, esz, dt, op, /*shift=*/-1, deadline);
+      ring_reduce_phase(bytes, bounds, esz, dt, op, /*shift=*/-1, deadline,
+                        full_ring());
     }
     std::memcpy(out, bytes + own_off, own_bytes);
     return own_bytes;
@@ -652,24 +724,42 @@ class Communicator {
 
   // element bounds per ring chunk (first n%ws chunks one element longer)
   std::vector<size_t> ring_bounds(size_t n) const {
-    int64_t ws = world_size_;
+    return ring_bounds(n, static_cast<size_t>(world_size_));
+  }
+
+  static std::vector<size_t> ring_bounds(size_t n, size_t ws) {
     std::vector<size_t> bounds(ws + 1, 0);
     size_t base = n / ws, extra = n % ws;
-    for (int64_t i = 0; i < ws; ++i)
-      bounds[i + 1] =
-          bounds[i] + base + (static_cast<size_t>(i) < extra ? 1 : 0);
+    for (size_t i = 0; i < ws; ++i)
+      bounds[i + 1] = bounds[i] + base + (i < extra ? 1 : 0);
     return bounds;
   }
 
-  // ring reduce phase: ws-1 duplex steps; with shift s, this rank ends up
-  // owning the fully-reduced chunk (rank + 1 + s) mod ws.  The (memory-
+  std::vector<int64_t> full_ring() const {
+    std::vector<int64_t> ring(world_size_);
+    for (int64_t i = 0; i < world_size_; ++i) ring[i] = i;
+    return ring;
+  }
+
+  static int64_t ring_pos(const std::vector<int64_t>& ring, int64_t rank) {
+    auto it = std::find(ring.begin(), ring.end(), rank);
+    if (it == ring.end())
+      throw CommError("rank " + std::to_string(rank) + " not in ring");
+    return it - ring.begin();
+  }
+
+  // ring reduce phase: ws-1 duplex steps over `ring` (global ranks in ring
+  // order; ws = ring.size()); with shift s, this rank's ring POSITION ends
+  // up owning the fully-reduced chunk (pos + 1 + s) mod ws.  The (memory-
   // bound) reduction rides under the wire via quantum-pipelined recv.
   void ring_reduce_phase(uint8_t* bytes, const std::vector<size_t>& bounds,
                          size_t esz, DType dt, RedOp op, int64_t shift,
-                         TimePoint deadline) {
-    int64_t ws = world_size_;
-    int64_t right = (rank_ + 1) % ws;
-    int64_t left = (rank_ - 1 + ws) % ws;
+                         TimePoint deadline,
+                         const std::vector<int64_t>& ring) {
+    int64_t ws = static_cast<int64_t>(ring.size());
+    int64_t pos = ring_pos(ring, rank_);
+    int64_t right = ring[(pos + 1) % ws];
+    int64_t left = ring[(pos - 1 + ws) % ws];
     auto chunk_ptr = [&](int64_t i) {
       i = ((i % ws) + ws) % ws;
       return bytes + bounds[i] * esz;
@@ -682,8 +772,8 @@ class Communicator {
     std::vector<int> left_fds = peer_fds(left);
     std::vector<std::vector<uint8_t>> scratches;  // grown once, reused/step
     for (int64_t step = 0; step < ws - 1; ++step) {
-      int64_t send_idx = rank_ - step + shift;
-      int64_t recv_idx = rank_ - step - 1 + shift;
+      int64_t send_idx = pos - step + shift;
+      int64_t recv_idx = pos - step - 1 + shift;
       std::string send_err;
       std::thread sender([&] {
         try {
@@ -707,12 +797,15 @@ class Communicator {
   }
 
   // ring allgather phase: ws-1 duplex steps circulating the fully-reduced
-  // chunks; with shift s, rank starts owning chunk (rank + 1 + s) mod ws.
+  // chunks over `ring`; with shift s, this rank's ring position starts
+  // owning chunk (pos + 1 + s) mod ws.
   void ring_allgather_phase(uint8_t* bytes, const std::vector<size_t>& bounds,
-                            size_t esz, int64_t shift, TimePoint deadline) {
-    int64_t ws = world_size_;
-    int64_t right = (rank_ + 1) % ws;
-    int64_t left = (rank_ - 1 + ws) % ws;
+                            size_t esz, int64_t shift, TimePoint deadline,
+                            const std::vector<int64_t>& ring) {
+    int64_t ws = static_cast<int64_t>(ring.size());
+    int64_t pos = ring_pos(ring, rank_);
+    int64_t right = ring[(pos + 1) % ws];
+    int64_t left = ring[(pos - 1 + ws) % ws];
     auto chunk_ptr = [&](int64_t i) {
       i = ((i % ws) + ws) % ws;
       return bytes + bounds[i] * esz;
@@ -724,8 +817,8 @@ class Communicator {
     std::vector<int> right_fds = peer_fds(right);
     std::vector<int> left_fds = peer_fds(left);
     for (int64_t step = 0; step < ws - 1; ++step) {
-      int64_t send_idx = rank_ + 1 + shift - step;
-      int64_t recv_idx = rank_ + shift - step;
+      int64_t send_idx = pos + 1 + shift - step;
+      int64_t recv_idx = pos + shift - step;
       std::string send_err;
       std::thread sender([&] {
         try {

@@ -46,6 +46,11 @@ class Failure(enum.Enum):
     COMM_ABORT = "commabort"  # comms die under the replica (NIC analog)
     LIGHTHOUSE = "lighthouse"  # coordination plane dies + restarts
     HEAL_SOURCE = "healsource"  # die mid-transfer while SERVING a heal
+    HOST_LEADER = "hostleader"  # kill a replica currently LEADING its host
+    # group in the hierarchical data plane: its host's members lose their
+    # shm hub and the cross-host ring loses a member mid-collective; the
+    # next quorum must re-elect a leader (lowest surviving rank) and
+    # /dev/shm must hold no orphaned segments (unlinked-after-map)
 
 
 @dataclass
@@ -147,10 +152,32 @@ class ThreadReplica(ReplicaHandle):
     def supports(self, failure: Failure) -> bool:
         if failure is Failure.HEAL_SOURCE:
             return getattr(self._obj, "heal_transport", None) is not None
+        if failure is Failure.HOST_LEADER:
+            return self._is_host_leader()
         return failure in (Failure.KILL, Failure.DEADLOCK, Failure.COMM_ABORT)
 
+    def _is_host_leader(self) -> bool:
+        comm = getattr(self._obj, "comm", None)
+        topo_fn = getattr(comm, "hier_topology", None)
+        if not callable(topo_fn):
+            return False
+        try:
+            topo = topo_fn()
+        except Exception:  # noqa: BLE001 — comm mid-reconfigure
+            return False
+        return bool(topo and topo.get("is_leader"))
+
     def inject(self, failure: Failure, **kw: Any) -> None:
-        if failure is Failure.KILL:
+        if failure is Failure.HOST_LEADER:
+            # targeted KILL conditioned on the victim's CURRENT topology
+            # role — leadership is per-epoch (lowest surviving rank of the
+            # host group), so the role is checked at inject time
+            if not self._is_host_leader():
+                raise RuntimeError(
+                    f"{self.name}: not a host leader in the current epoch"
+                )
+            self._obj.kill_flag.set()
+        elif failure is Failure.KILL:
             self._obj.kill_flag.set()
         elif failure is Failure.DEADLOCK:
             self._obj.wedge_secs = float(kw.get("secs", 10.0))
@@ -206,13 +233,14 @@ class ProcessReplica(ReplicaHandle):
             Failure.SEGFAULT,
             Failure.DEADLOCK,
             Failure.HEAL_SOURCE,
+            Failure.HOST_LEADER,
         )
 
     def inject(self, failure: Failure, **kw: Any) -> None:
-        if failure in (Failure.KILL, Failure.HEAL_SOURCE):
-            # process plane: a heal-source kill IS a hard kill — the caller
-            # times it against an in-flight heal (the thread plane gets the
-            # deterministic byte-threshold form instead)
+        if failure in (Failure.KILL, Failure.HEAL_SOURCE, Failure.HOST_LEADER):
+            # process plane: a heal-source or host-leader kill IS a hard
+            # kill — the caller picks a victim it knows holds the role (the
+            # thread plane checks the role itself via the live comm)
             ok = self._supervisor.kill(self._gid, sig=signal.SIGKILL)
         elif failure is Failure.SEGFAULT:
             ok = self._supervisor.kill(self._gid, sig=signal.SIGSEGV)

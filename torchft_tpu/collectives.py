@@ -395,6 +395,68 @@ def _allreduce_pipelined_sync(
     return out[:n]
 
 
+def _hier_topology(comm: Communicator) -> Optional[dict]:
+    """The epoch's ACTIVE hierarchical topology (uniform across ranks), or
+    None for flat tiers/epochs."""
+    fn = getattr(comm, "hier_topology", None)
+    return fn() if callable(fn) else None
+
+
+def _hier_allreduce_quantized_sync(
+    comm: Communicator,
+    topo: dict,
+    flat: np.ndarray,
+    row_size: int,
+    kind: str,
+    tag_base: int,
+) -> np.ndarray:
+    """Topology-aware quantized SUM-allreduce: reduce float32 once per host
+    over shared memory, quantize ONCE PER HOST, run the windowed pipeline
+    only among host leaders, shm-broadcast the dequantized sum back out.
+    Int8 wire bytes drop by the local-group factor on top of the 4x from
+    quantization, and non-leaders never touch the DCN.
+
+    Numerics differ from the flat pipeline (host contributions are summed
+    in f32 BEFORE quantization — strictly less quantization error), so the
+    contract vs the true sum is the same quantized tolerance, not
+    bit-equality with the flat path."""
+    # any stage failure degrades toward zeros but KEEPS the shm schedule —
+    # skipping the broadcast would leave host peers spinning until their
+    # deadline (the underlying shm ops run on the op thread even when a
+    # wrapper fails only the returned future), then re-raises so the step
+    # is voted down; same containment contract as the flat pipeline
+    err: Optional[BaseException] = None
+    host_sum: Optional[np.ndarray] = None
+    try:
+        host_sum = comm.intra_reduce(flat).wait()  # type: ignore[attr-defined]
+    except BaseException as e:  # noqa: BLE001
+        err = e
+    out: Optional[np.ndarray] = None
+    if topo["is_leader"]:
+        try:
+            if host_sum is None:
+                raise err or CommunicatorError("intra-host reduce failed")
+            q, scales = quantize_rowwise(host_sum, row_size, kind)
+            lead = comm.leader_comm()  # type: ignore[attr-defined]
+            if lead.size() > 1:
+                out = _allreduce_pipelined_sync(
+                    lead, q, scales, flat.size, tag_base=tag_base
+                )
+            else:
+                # single host: the wire round-trip degenerates but the
+                # quantization error stays observable, like ws==1 flat
+                out = dequantize_rowwise(q, scales, flat.size, np.float32)
+        except BaseException as e:  # noqa: BLE001
+            err = err or e
+            out = np.zeros(flat.size, dtype=np.float32)
+    summed = comm.intra_broadcast(  # type: ignore[attr-defined]
+        out, flat.size, np.float32
+    ).wait()
+    if err is not None:
+        raise err
+    return summed
+
+
 def _allreduce_quantized_sync(
     comm: Communicator, arrays: List[np.ndarray], row_size: int, kind: str = INT8
 ) -> List[np.ndarray]:
@@ -402,8 +464,16 @@ def _allreduce_quantized_sync(
     flat = np.concatenate(
         [np.asarray(a, dtype=np.float32).reshape(-1) for a in arrays]
     )
-    q, scales = quantize_rowwise(flat, row_size, kind)
-    summed = _allreduce_pipelined_sync(comm, q, scales, flat.size, tag_base=110)
+    topo = _hier_topology(comm)
+    if topo is not None:
+        summed = _hier_allreduce_quantized_sync(
+            comm, topo, flat, row_size, kind, tag_base=110
+        )
+    else:
+        q, scales = quantize_rowwise(flat, row_size, kind)
+        summed = _allreduce_pipelined_sync(
+            comm, q, scales, flat.size, tag_base=110
+        )
 
     out: List[np.ndarray] = []
     off = 0
@@ -428,6 +498,15 @@ def allreduce_prequantized(
     scales = np.asarray(scales).reshape(-1)
     if comm.size() == 1 or getattr(comm, "is_passthrough", False):
         return dequantize_rowwise(q, scales, n, np.float32)
+    topo = _hier_topology(comm)
+    if topo is not None:
+        # prequantized input on a hierarchical topology: dequantize locally
+        # (host-side f32, the shm hop is cheap) and take the once-per-host
+        # requantize path — leaders alone quantize for the DCN
+        flat = dequantize_rowwise(q, scales, n, np.float32)
+        return _hier_allreduce_quantized_sync(
+            comm, topo, flat, q.shape[1], _kind_of(q), tag_base=1050
+        )
     return _allreduce_pipelined_sync(comm, q, scales, n, tag_base=1050)
 
 
@@ -500,9 +579,31 @@ def reduce_scatter_quantized(
 
     def _run() -> None:
         try:
-            q_red, s_red, _rows, rows_per_rank = _quantized_reduce_scatter_sync(
-                comm, flat, row_size, tag=103, kind=kind
-            )
+            topo = _hier_topology(comm)
+            if topo is not None:
+                # hierarchical: once-per-host quantized allreduce, then
+                # requantize the full sum and slice this rank's row-shard —
+                # same shard geometry as the flat alltoall path
+                summed = _hier_allreduce_quantized_sync(
+                    comm, topo, flat, row_size, kind, tag_base=103
+                )
+                q_full, s_full = quantize_rowwise(summed, row_size, kind)
+                ws = comm.size()
+                rows_per_rank = -(-q_full.shape[0] // ws)
+                r = comm.rank()
+                q_red = np.zeros((rows_per_rank, row_size), wire_dtype(kind))
+                s_red = np.zeros(rows_per_rank, np.float32)
+                shard = q_full[r * rows_per_rank : (r + 1) * rows_per_rank]
+                q_red[: shard.shape[0]] = shard
+                s_red[: shard.shape[0]] = s_full[
+                    r * rows_per_rank : r * rows_per_rank + shard.shape[0]
+                ]
+            else:
+                q_red, s_red, _rows, rows_per_rank = (
+                    _quantized_reduce_scatter_sync(
+                        comm, flat, row_size, tag=103, kind=kind
+                    )
+                )
             total = (q_red.astype(np.float32) * s_red[:, None]).reshape(-1)
             fut.set_result(total)
         except BaseException as e:  # noqa: BLE001

@@ -31,13 +31,17 @@ that runs anywhere; the same interface is implemented by the C++ runtime
 from __future__ import annotations
 
 import logging
+import mmap
 import os
+import platform
 import queue
 import select
 import socket
 import struct
+import tempfile
 import threading
 import time
+import uuid
 from abc import ABC, abstractmethod
 from concurrent.futures import Future
 from enum import Enum
@@ -213,6 +217,12 @@ class Communicator(ABC):
         striping or before configure."""
         return {}
 
+    def hier_topology(self) -> Optional[Dict[str, object]]:
+        """Facts of the epoch's active hierarchical host topology (host
+        count, local group, leader ring) or None when collectives run flat.
+        Tiers without topology awareness report None."""
+        return None
+
     def shutdown(self) -> None:
         ...
 
@@ -248,6 +258,47 @@ class _StreamBucket:
         self._tokens -= n
 
 
+class _LinkBucket:
+    """Process-shared token bucket for one emulated LINK — the host NIC:
+    a :class:`_StreamBucket` (same capped accrual math, one source of
+    truth) behind a lock, because op threads of several communicators pace
+    concurrently.
+
+    Every communicator in a process draws from the same bucket (keyed by
+    the link parameters), because one process models one host: replicas
+    co-located on a host share its uplink, which is exactly the contention
+    the hierarchical collectives exist to relieve.  Benches emulate an
+    N-replica host by running N ranks as threads of one process
+    (``dcn_bench.py --hosts``); single-rank processes (the existing bench
+    layouts) are unaffected — their bucket has one tenant."""
+
+    __slots__ = ("_bucket", "_lock")
+
+    def __init__(self, rate: float, burst: int) -> None:
+        self._bucket = _StreamBucket(rate, burst)
+        self._lock = threading.Lock()
+
+    def allow(self, want: int) -> int:
+        with self._lock:
+            return self._bucket.allow(want)
+
+    def consume(self, n: int) -> None:
+        with self._lock:
+            self._bucket.consume(n)
+
+
+_LINK_BUCKETS: Dict[Tuple[float, int], _LinkBucket] = {}
+_LINK_BUCKETS_LOCK = threading.Lock()
+
+
+def _shared_link(rate: float, burst: int) -> _LinkBucket:
+    with _LINK_BUCKETS_LOCK:
+        bucket = _LINK_BUCKETS.get((rate, burst))
+        if bucket is None:
+            bucket = _LINK_BUCKETS[(rate, burst)] = _LinkBucket(rate, burst)
+        return bucket
+
+
 class _NetEmu:
     """Deterministic sender-side network emulation (netem analog) for the
     TCP tier: a shared token-bucket link cap, a per-connection cwnd-limited
@@ -280,12 +331,15 @@ class _NetEmu:
             cwnd_bytes / self.rtt_s if cwnd_bytes > 0 and self.rtt_s > 0 else 0.0
         )
         self.cwnd_bytes = cwnd_bytes
-        # classic capped token bucket: credit must NOT accrue while idle,
-        # or the first send after any pause bursts at loopback speed and
-        # the measured rate exceeds the emulated link
         self.burst = max(64 << 10, int(self.bytes_per_s * 0.005))
-        self._tokens = float(self.burst)
-        self._last = time.monotonic()
+        # the LINK bucket is process-shared (one process = one emulated
+        # host NIC; see _LinkBucket); stream buckets stay per-mesh since a
+        # cwnd is per-connection state
+        self._link = (
+            _shared_link(self.bytes_per_s, self.burst)
+            if self.bytes_per_s > 0
+            else None
+        )
         self._streams: Dict[object, _StreamBucket] = {}
 
     def frame_gate(self) -> float:
@@ -302,14 +356,8 @@ class _NetEmu:
     def allow(self, want: int, stream: object = None) -> int:
         """Bytes the link (and, when RTT emulation is on, ``stream``'s cwnd
         bucket) permit right now (<= ``want``)."""
-        if self.bytes_per_s > 0:
-            now = time.monotonic()
-            self._tokens = min(
-                float(self.burst),
-                self._tokens + (now - self._last) * self.bytes_per_s,
-            )
-            self._last = now
-            want = max(0, min(want, int(self._tokens)))
+        if self._link is not None:
+            want = self._link.allow(want)
         if stream is not None and self.stream_bytes_per_s > 0 and want > 0:
             bucket = self._streams.get(stream)
             if bucket is None:
@@ -320,7 +368,8 @@ class _NetEmu:
         return want
 
     def consume(self, n: int, stream: object = None) -> None:
-        self._tokens -= n
+        if self._link is not None:
+            self._link.consume(n)
         if stream is not None and self.stream_bytes_per_s > 0:
             bucket = self._streams.get(stream)
             if bucket is not None:
@@ -464,6 +513,223 @@ def _lane_parts(
     return [(lane, bounds[lane], bounds[lane + 1]) for lane in range(k)]
 
 
+# ---------------------------------------------------------------------------
+# host topology + shared-memory intra-host transport
+# ---------------------------------------------------------------------------
+
+# Hierarchical (topology-aware) collectives gate: "auto" (default) turns
+# the two-level schedule on when the discovered topology has >= 2 hosts AND
+# at least one host holds >= 2 replicas — the regime where flat rings push
+# every byte across the DCN once per REPLICA instead of once per HOST.
+# "1" forces it on (any topology, including all-one-host: collectives then
+# run entirely over shared memory); "0" pins the flat ring, byte-for-byte
+# identical to the pre-topology wire behavior.  A peer that speaks no
+# topology (gate "0", legacy or native-tier build) never publishes its
+# topology key: "auto" groups deterministically fall back to the flat ring
+# (the key lands in the store before the dialable address, so absence
+# after rendezvous is a fact, not a race); a forced "1" fails loudly.
+HIERARCHICAL_ENV = "TORCHFT_HIERARCHICAL"
+# Overrides host-group identity for this replica.  Default grouping is by
+# the advertised rendezvous address' host part (same-IP grouping), which is
+# right for one-process-per-replica SLURM/bench layouts; set distinct
+# TORCHFT_HOST_ID values to partition co-located replicas into emulated
+# hosts, or identical values to co-group replicas NAT'd behind one IP.
+HOST_ID_ENV = "TORCHFT_HOST_ID"
+# Per-member slot capacity of the intra-host shared-memory segment, MiB.
+# Payloads larger than a slot stream through it in chunks.
+SHM_SLOT_MB_ENV = "TORCHFT_SHM_SLOT_MB"
+_SHM_SLOT_DEFAULT_MB = 16.0
+
+
+def _hier_mode(override: Optional[str] = None) -> str:
+    raw = (
+        override
+        if override is not None
+        else os.environ.get(HIERARCHICAL_ENV, "auto")
+    )
+    raw = str(raw).strip().lower()
+    if raw in ("", "auto"):
+        return "auto"
+    if raw in ("1", "true", "on"):
+        return "1"
+    if raw in ("0", "false", "off"):
+        return "0"
+    raise CommunicatorError(
+        f"unparseable {HIERARCHICAL_ENV}={raw!r} (auto|0|1)"
+    )
+
+
+def _shm_slot_bytes() -> int:
+    raw = os.environ.get(SHM_SLOT_MB_ENV, "").strip()
+    try:
+        mb = float(raw) if raw else _SHM_SLOT_DEFAULT_MB
+    except ValueError as e:
+        raise CommunicatorError(
+            f"unparseable {SHM_SLOT_MB_ENV}={raw!r} (MiB)"
+        ) from e
+    # 64-byte multiple so chunk boundaries never split an element of any
+    # supported dtype (same rationale as _STRIPE_ALIGN)
+    return max(64 << 10, int(mb * (1 << 20)) // 64 * 64)
+
+
+class _HostTopology:
+    """Host grouping of one quorum epoch, identical on every rank.
+
+    Hosts are ordered by their smallest global rank; each host's leader IS
+    that smallest rank, and the cross-host ring runs over ``leader_ring``
+    in that order — all derived from the (rank -> host id) map alone, so
+    every rank computes the same schedule with no extra wire metadata.
+    The native tier (``native/comm.h HostTopology``) implements the
+    identical ordering so the tiers stay wire-compatible."""
+
+    def __init__(self, host_of: Dict[int, str], rank: int) -> None:
+        self.host_of = dict(host_of)
+        groups: Dict[str, List[int]] = {}
+        for r in sorted(host_of):
+            groups.setdefault(host_of[r], []).append(r)
+        self.hosts: List[List[int]] = sorted(
+            groups.values(), key=lambda g: g[0]
+        )
+        self.leader_ring: List[int] = [g[0] for g in self.hosts]
+        self.local: List[int] = next(g for g in self.hosts if rank in g)
+        self.leader: int = self.local[0]
+        self.is_leader: bool = rank == self.leader
+        self.local_index: int = self.local.index(rank)
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def local_world(self) -> int:
+        return len(self.local)
+
+    def worth_it(self) -> bool:
+        """The "auto" criterion: hierarchy only pays when a cross-host ring
+        exists AND some host would otherwise push duplicate bytes."""
+        return self.num_hosts > 1 and any(len(g) > 1 for g in self.hosts)
+
+
+_SHM_ABORT_OFF = 0  # u64 abort latch at the head of the segment header
+_SHM_HDR = 64
+_SHM_SLOT_HDR = 64  # u64 publish-sequence, padded to a cache line
+
+
+class _ShmSeg:
+    """mmap'd per-host segment: the zero-socket intra-host transport.
+
+    The host leader creates a file under ``/dev/shm`` (tmpdir fallback),
+    every local member maps it, and the leader unlinks it the moment all
+    members acknowledge the mapping — unlinked-after-map, so a killed
+    replica leaks nothing: the kernel frees the pages when the last
+    mapping dies, and ``/dev/shm`` never shows an orphan.
+
+    One slot per local member plus a seqlock-style publish protocol:
+    a writer copies its payload into its slot and then publishes a
+    monotonically increasing sequence number; readers spin (abort- and
+    deadline-checked) until the slot's sequence reaches the op's expected
+    value.  The sequence store happens strictly after the payload copy
+    (single ``struct.pack_into`` following the slice assignment), which on
+    the GIL within a process — and x86-TSO across processes — is exactly
+    the publish-after-payload order a seqlock needs.  Flow control is
+    lock-step per chunk: the consumer republishes the same sequence on its
+    OWN slot as an ack before the producer may overwrite.
+
+    ``_seq`` is a local op counter advanced identically on every member
+    (collectives execute in submission order on each rank's op thread, and
+    submission order matches across ranks), so expected sequence values
+    never ride the wire either."""
+
+    def __init__(self, mm: mmap.mmap, members: int, slot_bytes: int) -> None:
+        self._mm = mm
+        self.members = members
+        self.slot_bytes = slot_bytes
+        self._seq = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @staticmethod
+    def size_for(members: int, slot_bytes: int) -> int:
+        return _SHM_HDR + members * (_SHM_SLOT_HDR + slot_bytes)
+
+    @classmethod
+    def create(cls, members: int, slot_bytes: int) -> Tuple["_ShmSeg", str]:
+        base = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+        path = os.path.join(base, f"tpuft_shm_{uuid.uuid4().hex}")
+        nbytes = cls.size_for(members, slot_bytes)
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, nbytes)
+            mm = mmap.mmap(fd, nbytes)
+        except BaseException:
+            os.close(fd)
+            os.unlink(path)
+            raise
+        os.close(fd)
+        return cls(mm, members, slot_bytes), path
+
+    @classmethod
+    def attach(cls, path: str, members: int, slot_bytes: int) -> "_ShmSeg":
+        nbytes = cls.size_for(members, slot_bytes)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            mm = mmap.mmap(fd, nbytes)
+        finally:
+            os.close(fd)
+        return cls(mm, members, slot_bytes)
+
+    def _slot_off(self, idx: int) -> int:
+        return _SHM_HDR + idx * (_SHM_SLOT_HDR + self.slot_bytes)
+
+    # -- abort latch ---------------------------------------------------------
+
+    def set_abort(self) -> None:
+        try:
+            struct.pack_into("<Q", self._mm, _SHM_ABORT_OFF, 1)
+        except ValueError:  # pragma: no cover - segment already torn down
+            pass
+
+    def aborted(self) -> bool:
+        return struct.unpack_from("<Q", self._mm, _SHM_ABORT_OFF)[0] != 0
+
+    # -- seqlock publish / wait ---------------------------------------------
+
+    def post(self, idx: int, seq: int, payload: Optional[memoryview]) -> None:
+        """Copy ``payload`` (None = flag-only ack) into slot ``idx``, then
+        publish ``seq``."""
+        off = self._slot_off(idx)
+        if payload is not None and len(payload) > 0:
+            start = off + _SHM_SLOT_HDR
+            self._mm[start : start + len(payload)] = payload
+        struct.pack_into("<Q", self._mm, off, seq)
+
+    def wait(
+        self,
+        idx: int,
+        seq: int,
+        deadline: float,
+        extra_abort: Optional[threading.Event] = None,
+    ) -> None:
+        """Spin until slot ``idx`` publishes a sequence >= ``seq``."""
+        off = self._slot_off(idx)
+        spins = 0
+        while struct.unpack_from("<Q", self._mm, off)[0] < seq:
+            if self.aborted() or (
+                extra_abort is not None and extra_abort.is_set()
+            ):
+                raise CommunicatorAborted("communicator aborted (shm)")
+            if time.monotonic() > deadline:
+                raise TimeoutError("intra-host shm op timed out")
+            spins += 1
+            # yield the GIL so a sibling-thread writer can run; back off to
+            # a real sleep once it is clearly a cross-process wait
+            time.sleep(0 if spins < 2000 else 0.0002)
+
+    def view(self, idx: int, nbytes: int) -> memoryview:
+        start = self._slot_off(idx) + _SHM_SLOT_HDR
+        return memoryview(self._mm)[start : start + nbytes]
+
+
 class _TcpMesh:
     """Full mesh of rank-to-rank lane sockets for one quorum epoch.
 
@@ -490,6 +756,8 @@ class _TcpMesh:
         world_size: int,
         timeout_s: float,
         lanes: int = 0,
+        host_id: Optional[str] = None,
+        hier: Optional[str] = None,
     ) -> None:
         self.rank = rank
         self.world_size = world_size
@@ -510,6 +778,13 @@ class _TcpMesh:
         self.lane_tx_bytes = [0] * self.lanes
         self.lane_rx_bytes = [0] * self.lanes
         self.lane_stalls = [0] * self.lanes
+        # topology (hierarchical collectives): filled by _topo_rendezvous
+        # below; None = flat ring (the byte-for-byte legacy data plane)
+        self.topo: Optional[_HostTopology] = None
+        self.shm: Optional[_ShmSeg] = None
+        self.shm_tx_bytes = 0
+        self.shm_rx_bytes = 0
+        hier_mode = _hier_mode(hier)
 
         store = create_store_client(store_addr, timeout=timeout_s)
 
@@ -521,6 +796,22 @@ class _TcpMesh:
             socket.getaddrinfo(host, port)
         except socket.gaierror:
             host = "127.0.0.1"
+        self._my_host_id = host_id or os.environ.get(HOST_ID_ENV) or host
+        if "|" in self._my_host_id:
+            raise CommunicatorError(
+                f"host id {self._my_host_id!r} must not contain '|'"
+            )
+        if hier_mode != "0":
+            # published BEFORE the dialable address: a completed socket mesh
+            # then implies every topology-speaking peer's key is already
+            # visible, so "key absent" after rendezvous is a deterministic
+            # legacy/native-tier signal (fall back to flat), never a race.
+            # The MODE rides along so an auto-vs-forced disagreement (which
+            # would let one rank engage the two-level schedule while a peer
+            # stays flat) fails loudly, like the lane-count hello.
+            store.set(
+                f"topo_{rank}", f"{hier_mode}|{self._my_host_id}".encode()
+            )
         store.set(f"{rank}", f"{host}:{port}".encode())
 
         expected_inbound = (world_size - rank - 1) * self.lanes
@@ -614,6 +905,209 @@ class _TcpMesh:
             if lane == 0:
                 self.peers[peer] = sock
 
+        if hier_mode != "0":
+            try:
+                self._topo_rendezvous(store, hier_mode, timeout_s)
+            except BaseException:
+                self.abort()  # close the lane sockets a failed epoch leaves
+                raise
+
+    def _topo_rendezvous(self, store, hier_mode: str, timeout_s: float) -> None:
+        """Host-group discovery + per-host shared-memory segment setup.
+
+        Every topology-speaking rank published its host identity under
+        ``topo_{rank}`` (the explicit ctor/``TORCHFT_HOST_ID`` override,
+        else the host part of its advertised rendezvous address — same-IP
+        grouping) BEFORE its dialable address, so with the socket mesh up
+        every such key is already visible.  A peer with no key is a
+        legacy/native-tier build or runs ``TORCHFT_HIERARCHICAL=0``: in
+        "auto" mode the whole group deterministically falls back to the
+        flat ring (every rank observes the same missing key); a FORCED "1"
+        fails loudly instead — the operator demanded a schedule the peer
+        cannot speak."""
+        host_of = {self.rank: self._my_host_id}
+        for peer in range(self.world_size):
+            if peer == self.rank:
+                continue
+            # present-or-never (see publication ordering above), so the
+            # non-blocking exists() is unambiguous: False IS "peer speaks
+            # no topology", never "not yet".  A store ERROR must raise —
+            # mapping it to the flat fallback could desync this rank's
+            # schedule from peers that read the key fine.
+            if not store.exists(f"topo_{peer}"):
+                if hier_mode == "1":
+                    raise CommunicatorError(
+                        f"rank {peer} published no topology key — "
+                        f"{HIERARCHICAL_ENV}=1 requires every replica "
+                        "(and tier) to speak topology"
+                    )
+                logger.info(
+                    "topology: rank %d speaks no topology; flat ring", peer
+                )
+                return
+            peer_mode, peer_host = (
+                store.get(f"topo_{peer}", timeout=timeout_s)
+                .decode()
+                .split("|", 1)
+            )
+            if peer_mode != hier_mode:
+                # auto-vs-forced would leave the engaged/flat decision to
+                # each rank's own gate — a silent schedule desync on any
+                # topology where the two disagree.  Loud, like lanes.
+                raise CommunicatorError(
+                    f"{HIERARCHICAL_ENV} mismatch: rank {peer} runs "
+                    f"{peer_mode!r}, we run {hier_mode!r} (must be uniform)"
+                )
+            host_of[peer] = peer_host
+        topo = _HostTopology(host_of, self.rank)
+        if hier_mode != "1" and not topo.worth_it():
+            return  # auto: flat topology, keep the legacy ring
+        if platform.machine().lower() not in ("x86_64", "amd64"):
+            # the shm seqlock's publish-after-payload ordering leans on
+            # x86-TSO for CROSS-PROCESS members; weaker memory models could
+            # let a reader see the sequence before the payload lands
+            if hier_mode == "1":
+                raise CommunicatorError(
+                    "the shared-memory intra-host transport requires a TSO "
+                    f"architecture (x86_64); this host is "
+                    f"{platform.machine()!r} — unset {HIERARCHICAL_ENV}"
+                )
+            logger.warning(
+                "topology: non-TSO architecture %s; flat ring",
+                platform.machine(),
+            )
+            return
+        self.topo = topo
+        if topo.local_world == 1:
+            return  # leader-only host: the cross-host ring needs no shm
+        # the leader's slot size wins so an intra-host TORCHFT_SHM_SLOT_MB
+        # disagreement can corrupt nothing — members adopt it from the key
+        if topo.is_leader:
+            slot_bytes = _shm_slot_bytes()
+            seg, path = _ShmSeg.create(topo.local_world, slot_bytes)
+            store.set(f"shmseg_{topo.leader}", f"{path}|{slot_bytes}".encode())
+            try:
+                for member in topo.local[1:]:
+                    store.get(f"shmok_{member}", timeout=timeout_s)
+            finally:
+                # unlinked-after-map: from here the segment exists only as
+                # live mappings; a killed replica leaks nothing in /dev/shm
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            self.shm = seg
+        else:
+            raw = store.get(f"shmseg_{topo.leader}", timeout=timeout_s).decode()
+            path, slot_raw = raw.rsplit("|", 1)
+            self.shm = _ShmSeg.attach(path, topo.local_world, int(slot_raw))
+            store.set(f"shmok_{self.rank}", b"1")
+
+    # -- intra-host shared-memory collectives --------------------------------
+
+    def _shm_chunks(self, nbytes: int) -> List[Tuple[int, int]]:
+        assert self.shm is not None
+        cap = self.shm.slot_bytes
+        if nbytes == 0:
+            return [(0, 0)]
+        return [(s, min(s + cap, nbytes)) for s in range(0, nbytes, cap)]
+
+    def shm_reduce(self, flat: np.ndarray, op: ReduceOp, deadline: float) -> None:
+        """Intra-host reduce into the host leader's ``flat``, in FIXED
+        ascending global-rank order (run-to-run deterministic: the leader's
+        own buffer is the accumulator, members fold in by local index).
+        Members' buffers are left untouched; lock-step per chunk — the
+        leader's ack republish gates each member's next chunk."""
+        seg, topo = self.shm, self.topo
+        assert topo is not None
+        if seg is None or topo.local_world == 1:
+            return
+        view = _bytes_view(flat)
+        chunks = self._shm_chunks(view.nbytes)
+        base = seg._seq
+        itemsize = flat.dtype.itemsize
+        me = topo.local_index
+        if me == 0:
+            acc = flat.reshape(-1)
+            for c, (s, e) in enumerate(chunks):
+                lo, hi = s // itemsize, e // itemsize
+                for j in range(1, topo.local_world):
+                    seg.wait(j, base + c + 1, deadline, self._aborted)
+                    incoming = np.frombuffer(
+                        seg.view(j, e - s), dtype=flat.dtype
+                    )
+                    _reduce_into(op, acc[lo:hi], incoming)
+                    self.shm_rx_bytes += e - s
+                seg.post(0, base + c + 1, None)  # ack: slots may be reused
+        else:
+            for c, (s, e) in enumerate(chunks):
+                seg.post(me, base + c + 1, view[s:e])
+                self.shm_tx_bytes += e - s
+                seg.wait(0, base + c + 1, deadline, self._aborted)
+        seg._seq = base + len(chunks)
+
+    def shm_bcast(
+        self, flat: np.ndarray, deadline: float, src_idx: int = 0
+    ) -> None:
+        """Intra-host broadcast of ``flat`` from local member ``src_idx``
+        (the leader by default) into every other member's ``flat``."""
+        seg, topo = self.shm, self.topo
+        assert topo is not None
+        if seg is None or topo.local_world == 1:
+            return
+        view = _bytes_view(flat)
+        chunks = self._shm_chunks(view.nbytes)
+        base = seg._seq
+        me = topo.local_index
+        readers = [j for j in range(topo.local_world) if j != src_idx]
+        if me == src_idx:
+            for c, (s, e) in enumerate(chunks):
+                seg.post(src_idx, base + c + 1, view[s:e])
+                self.shm_tx_bytes += e - s
+                for j in readers:
+                    seg.wait(j, base + c + 1, deadline, self._aborted)
+        else:
+            for c, (s, e) in enumerate(chunks):
+                seg.wait(src_idx, base + c + 1, deadline, self._aborted)
+                view[s:e] = seg.view(src_idx, e - s)
+                self.shm_rx_bytes += e - s
+                seg.post(me, base + c + 1, None)  # ack
+        seg._seq = base + len(chunks)
+
+    def shm_gather(
+        self, arr: np.ndarray, deadline: float
+    ) -> Optional[List[np.ndarray]]:
+        """Intra-host gather: the leader returns every local member's
+        buffer (local-group order, its own included); members return None.
+        Same shape/dtype on every member."""
+        seg, topo = self.shm, self.topo
+        assert topo is not None
+        if seg is None or topo.local_world == 1:
+            return [arr] if topo.is_leader else None
+        view = _bytes_view(arr)
+        chunks = self._shm_chunks(view.nbytes)
+        base = seg._seq
+        me = topo.local_index
+        out: Optional[List[np.ndarray]] = None
+        if me == 0:
+            out = [arr] + [
+                np.empty_like(arr) for _ in range(topo.local_world - 1)
+            ]
+            views = [_bytes_view(a) for a in out]
+            for c, (s, e) in enumerate(chunks):
+                for j in range(1, topo.local_world):
+                    seg.wait(j, base + c + 1, deadline, self._aborted)
+                    views[j][s:e] = seg.view(j, e - s)
+                    self.shm_rx_bytes += e - s
+                seg.post(0, base + c + 1, None)  # ack
+        else:
+            for c, (s, e) in enumerate(chunks):
+                seg.post(me, base + c + 1, view[s:e])
+                self.shm_tx_bytes += e - s
+                seg.wait(0, base + c + 1, deadline, self._aborted)
+        seg._seq = base + len(chunks)
+        return out
+
     # -- lane lookups --------------------------------------------------------
 
     def lane_sock(self, peer: int, lane: int) -> socket.socket:
@@ -628,6 +1122,11 @@ class _TcpMesh:
 
     def abort(self) -> None:
         self._aborted.set()
+        if self.shm is not None:
+            # latch the abort into the shared segment so local members
+            # blocked in an shm spin (possibly in OTHER processes) unblock
+            # with CommunicatorAborted, same poison path as the sockets
+            self.shm.set_abort()
         for sock in self.lane_socks.values():
             try:
                 sock.close()
@@ -1178,8 +1677,19 @@ class TCPCommunicator(Communicator):
     once).
     """
 
-    def __init__(self, timeout_s: float = 60.0) -> None:
+    def __init__(
+        self,
+        timeout_s: float = 60.0,
+        host_id: Optional[str] = None,
+        hierarchical: Optional[str] = None,
+    ) -> None:
+        """``host_id`` / ``hierarchical`` override the ``TORCHFT_HOST_ID``
+        and ``TORCHFT_HIERARCHICAL`` env knobs per instance — the hook
+        thread-plane harnesses (where ranks share one process env) use to
+        build emulated multi-host topologies."""
         self._timeout_s = timeout_s
+        self._host_id = host_id
+        self._hier = hierarchical
         self._mesh: Optional[_TcpMesh] = None
         self._rank = 0
         self._world_size = 1
@@ -1219,7 +1729,14 @@ class TCPCommunicator(Communicator):
 
         mesh: Optional[_TcpMesh] = None
         if world_size > 1:
-            mesh = _TcpMesh(store_addr, rank, world_size, self._timeout_s)
+            mesh = _TcpMesh(
+                store_addr,
+                rank,
+                world_size,
+                self._timeout_s,
+                host_id=self._host_id,
+                hier=self._hier,
+            )
 
         with self._lock:
             if self._epoch != epoch:
@@ -1298,13 +1815,99 @@ class TCPCommunicator(Communicator):
         mesh = self._mesh
         if mesh is None:
             return {}
-        return {
+        stats: Dict[str, object] = {
             "lanes": mesh.lanes,
             "stripe_floor_bytes": mesh.stripe_floor,
             "lane_tx_bytes": list(mesh.lane_tx_bytes),
             "lane_rx_bytes": list(mesh.lane_rx_bytes),
             "lane_stalls": list(mesh.lane_stalls),
         }
+        if mesh.topo is not None:
+            stats.update(
+                topo_hosts=mesh.topo.num_hosts,
+                topo_local_world=mesh.topo.local_world,
+                topo_is_leader=mesh.topo.is_leader,
+                shm_tx_bytes=mesh.shm_tx_bytes,
+                shm_rx_bytes=mesh.shm_rx_bytes,
+            )
+        return stats
+
+    # -- hierarchical topology surface (collectives.py consumes this) --------
+
+    def hier_topology(self) -> Optional[Dict[str, object]]:
+        """Facts of the current epoch's ACTIVE hierarchical topology, or
+        None when the epoch runs the flat ring.  Identical on every rank
+        (derived from the shared host map), so callers may branch on it to
+        pick collective schedules without desynchronizing."""
+        mesh = self._mesh
+        if mesh is None or mesh.topo is None:
+            return None
+        t = mesh.topo
+        return {
+            "hosts": t.num_hosts,
+            "local_world": t.local_world,
+            "is_leader": t.is_leader,
+            "leader": t.leader,
+            "leader_ring": list(t.leader_ring),
+            "local_group": list(t.local),
+        }
+
+    def intra_reduce(self, flat: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> Work:
+        """Intra-host SUM (default) reduce of ``flat`` over shared memory:
+        the host leader's Work resolves to the host-reduced array (the
+        input, reduced in place on a private copy), members' to None.
+        No-socket op — safe to interleave with cross-host collectives."""
+        arr = np.array(flat, copy=True).reshape(-1)
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                mesh = ctx.mesh
+                if mesh is None or mesh.topo is None:
+                    return arr
+                mesh.shm_reduce(arr, op, ctx.deadline())
+                return arr if mesh.topo.is_leader else None
+
+            return _run
+
+        return self._submit(_make)
+
+    def intra_broadcast(
+        self,
+        flat: Optional[np.ndarray],
+        count: int,
+        dtype: "np.dtype" = np.float32,
+    ) -> Work:
+        """Intra-host broadcast from the host leader (which passes the
+        array; members pass None and receive a fresh one of ``count``
+        elements of ``dtype``)."""
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                mesh = ctx.mesh
+                if mesh is None or mesh.topo is None:
+                    return flat
+                arr = (
+                    np.ascontiguousarray(flat).reshape(-1)
+                    if flat is not None
+                    else np.empty(count, dtype=dtype)
+                )
+                mesh.shm_bcast(arr, ctx.deadline())
+                return arr
+
+            return _run
+
+        return self._submit(_make)
+
+    def leader_comm(self) -> "Communicator":
+        """A communicator view over the per-host leader subgroup of the
+        CURRENT epoch: size() = host count, rank() = this host's position
+        in the leader ring.  Valid only on leaders (members have no
+        business on the DCN in a hierarchical schedule); collectives ride
+        the same mesh, epoch and abort semantics as the parent."""
+        topo = self.hier_topology()
+        if topo is None:
+            return self
+        return _LeaderComm(self, list(topo["leader_ring"]))  # type: ignore[arg-type]
 
     # -- op submission -------------------------------------------------------
 
@@ -1451,7 +2054,24 @@ class TCPCommunicator(Communicator):
             def _run() -> object:
                 ws = ctx.world_size
                 flat = np.array(arr, copy=True).reshape(-1)
-                own = _ring_reduce_scatter(ctx, flat, op, tag_base=30_000)
+                topo = ctx.mesh.topo if ctx.mesh is not None else None
+                if topo is not None and len(topo.leader_ring) < ws:
+                    # hierarchical: full two-level allreduce (host-shm +
+                    # leader ring), then slice this rank's chunk.  Cross-
+                    # host bytes are 2(H-1)/H·n per host vs the flat ring's
+                    # L(ws-1)/ws·n — a win from L >= 2 replicas/host, a
+                    # wash at exactly 2; a leader-ring reduce-scatter with
+                    # an shm scatter would halve it again but needs
+                    # host-contiguous rank chunks, deferred until profiles
+                    # demand it.
+                    _hier_allreduce(ctx, flat, op, tag_base=30_000)
+                    bounds = _ring_bounds(flat.size, ws)
+                    own = flat[bounds[ctx.rank] : bounds[ctx.rank + 1]]
+                else:
+                    # flat, and also the forced one-replica-per-host
+                    # topology (leader ring == all ranks): the plain ring
+                    # reduce-scatter moves HALF the allreduce's bytes
+                    own = _ring_reduce_scatter(ctx, flat, op, tag_base=30_000)
                 if op == ReduceOp.AVG:
                     if np.issubdtype(own.dtype, np.integer):
                         own //= ws
@@ -1568,23 +2188,9 @@ class TCPCommunicator(Communicator):
 
         def _make(ctx: "_CommCtx") -> Callable[[], object]:
             def _run() -> object:
-                ws, rank = ctx.world_size, ctx.rank
-                if ws == 1:
-                    return [own]
-                mesh = ctx.mesh
-                assert mesh is not None
-                out = [np.empty_like(recv_template(p)) for p in range(ws)]
-                out[rank] = own
-                sends = [
-                    (p, tag, _bytes_view(send_for_peer(p)))
-                    for p in range(ws)
-                    if p != rank
-                ]
-                recvs = [
-                    (p, tag, _bytes_view(out[p])) for p in range(ws) if p != rank
-                ]
-                mesh.exchange(sends, recvs, ctx.deadline())
-                return out
+                return _all_exchange_sync(
+                    ctx, send_for_peer, recv_template, own, tag
+                )
 
             return _run
 
@@ -1606,14 +2212,30 @@ class TCPCommunicator(Communicator):
 
     def allgather(self, data: np.ndarray, tag: int = 0) -> Work:
         """Gather every rank's buffer (same shape/dtype on all ranks); the
-        Work's value is a list indexed by rank."""
+        Work's value is a list indexed by rank.  On a hierarchical topology
+        the gather runs host-blocked: shm to the host leader, leader-block
+        exchange across the DCN, shm broadcast back out."""
         array = np.ascontiguousarray(data)
-        return self._all_exchange(
-            send_for_peer=lambda p: array,
-            recv_template=lambda p: array,
-            own=array,
-            tag=5000 + tag,
-        )
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                if (
+                    ctx.world_size > 1
+                    and ctx.mesh is not None
+                    and ctx.mesh.topo is not None
+                ):
+                    return _hier_allgather_sync(ctx, array, 5000 + tag)
+                return _all_exchange_sync(
+                    ctx,
+                    send_for_peer=lambda p: array,
+                    recv_template=lambda p: array,
+                    own=array,
+                    tag=5000 + tag,
+                )
+
+            return _run
+
+        return self._submit(_make)
 
     def barrier(self) -> Work:
         def _make(ctx: "_CommCtx") -> Callable[[], object]:
@@ -1624,6 +2246,39 @@ class TCPCommunicator(Communicator):
             return _run
 
         return self._submit(_make)
+
+
+def _all_exchange_sync(
+    ctx: "_CommCtx",
+    send_for_peer: Callable[[int], np.ndarray],
+    recv_template: Callable[[int], np.ndarray],
+    own: np.ndarray,
+    tag: int,
+    ring: Optional[List[int]] = None,
+) -> List[np.ndarray]:
+    """All-to-all exchange body shared by alltoall, the non-hierarchical
+    allgather path, and (via ``ring`` — participating global ranks in
+    order, results indexed by ring position) the leader-subgroup views."""
+    if ring is None:
+        ring = list(range(ctx.world_size))
+    ws = len(ring)
+    if ws == 1:
+        return [own]
+    mesh = ctx.mesh
+    assert mesh is not None
+    pos = ring.index(ctx.rank)
+    out = [np.empty_like(recv_template(p)) for p in range(ws)]
+    out[pos] = own
+    sends = [
+        (ring[p], tag, _bytes_view(send_for_peer(p)))
+        for p in range(ws)
+        if p != pos
+    ]
+    recvs = [
+        (ring[p], tag, _bytes_view(out[p])) for p in range(ws) if p != pos
+    ]
+    mesh.exchange(sends, recvs, ctx.deadline())
+    return out
 
 
 class _CommCtx:
@@ -1652,6 +2307,96 @@ class _CommCtx:
         return self.mesh
 
 
+class _LeaderComm(Communicator):
+    """Leader-subgroup view of a :class:`TCPCommunicator` for one epoch.
+
+    The quantized DiLoCo pipeline runs its alltoall/allgather windows on
+    this view so only HOST LEADERS touch the DCN — one quantized stream per
+    host instead of one per replica.  Ops ride the parent's mesh, op
+    thread, epoch and abort semantics; rank()/size() are the leader-ring
+    position and host count.  Distinct tag bases (7000/8000) keep leader
+    frames un-confusable with flat alltoall/allgather frames."""
+
+    def __init__(self, parent: TCPCommunicator, ring: List[int]) -> None:
+        self._parent = parent
+        self._ring = ring
+
+    def configure(self, *args, **kwargs) -> None:  # type: ignore[override]
+        raise RuntimeError("_LeaderComm is a per-epoch view; configure the parent")
+
+    def rank(self) -> int:
+        return self._ring.index(self._parent.rank())
+
+    def size(self) -> int:
+        return len(self._ring)
+
+    def alltoall(self, chunks: List[np.ndarray], tag: int = 0) -> Work:
+        arrays = [np.ascontiguousarray(c) for c in chunks]
+        assert len(arrays) == len(self._ring), "need one chunk per leader"
+        ring = self._ring
+        pos = self.rank()
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                return _all_exchange_sync(
+                    ctx,
+                    send_for_peer=lambda p: arrays[p],
+                    recv_template=lambda p: arrays[p],
+                    own=arrays[pos],
+                    tag=7000 + tag,
+                    ring=ring,
+                )
+
+            return _run
+
+        return self._parent._submit(_make)
+
+    def allgather(self, data: np.ndarray, tag: int = 0) -> Work:
+        array = np.ascontiguousarray(data)
+        ring = self._ring
+
+        def _make(ctx: "_CommCtx") -> Callable[[], object]:
+            def _run() -> object:
+                return _all_exchange_sync(
+                    ctx,
+                    send_for_peer=lambda p: array,
+                    recv_template=lambda p: array,
+                    own=array,
+                    tag=8000 + tag,
+                    ring=ring,
+                )
+
+            return _run
+
+        return self._parent._submit(_make)
+
+    def allreduce(
+        self,
+        buffers: Buffers,
+        op: ReduceOp = ReduceOp.SUM,
+        in_place: bool = False,
+    ) -> Work:
+        raise NotImplementedError("leader view carries alltoall/allgather only")
+
+    def broadcast(self, buffers: Buffers, root: int = 0) -> Work:
+        raise NotImplementedError("leader view carries alltoall/allgather only")
+
+    def send_bytes(self, data: bytes, dst: int, tag: int = 0) -> Work:
+        raise NotImplementedError("leader view carries alltoall/allgather only")
+
+    def recv_bytes(self, src: int, tag: int = 0) -> Work:
+        raise NotImplementedError("leader view carries alltoall/allgather only")
+
+    def barrier(self) -> Work:
+        raise NotImplementedError("leader view carries alltoall/allgather only")
+
+    def abort(self, reason: str = "aborted") -> None:
+        self._parent.abort(reason)
+
+    def errored(self) -> Optional[Exception]:
+        return self._parent.errored()
+
+
 def _allreduce_sync(
     ctx: _CommCtx,
     arrays: List[np.ndarray],
@@ -1670,6 +2415,12 @@ def _allreduce_sync(
     ]
     if ws > 1:
         assert ctx.mesh is not None
+        # topology-aware dispatch: hierarchical when the epoch discovered a
+        # multi-host topology (mesh.topo is uniform across ranks), else the
+        # byte-for-byte legacy flat ring
+        reduce_flat = (
+            _hier_allreduce if ctx.mesh.topo is not None else _ring_allreduce
+        )
         # one flat ring per dtype — concatenating mixed dtypes would silently
         # promote (f32+i64 → f64) and return wrong-dtype buffers
         by_dtype: Dict[str, List[int]] = {}
@@ -1678,11 +2429,11 @@ def _allreduce_sync(
         for ring_idx, idxs in enumerate(by_dtype.values()):
             if len(idxs) == 1 and out[idxs[0]].flags.c_contiguous:
                 flat = out[idxs[0]].reshape(-1)
-                _ring_allreduce(ctx, flat, op, tag_base=ring_idx * 10_000)
+                reduce_flat(ctx, flat, op, tag_base=ring_idx * 10_000)
                 out[idxs[0]] = flat.reshape(out[idxs[0]].shape)
                 continue
             flat = np.concatenate([out[i].reshape(-1) for i in idxs])
-            _ring_allreduce(ctx, flat, op, tag_base=ring_idx * 10_000)
+            reduce_flat(ctx, flat, op, tag_base=ring_idx * 10_000)
             offset = 0
             for i in idxs:
                 n = out[i].size
@@ -1708,19 +2459,31 @@ def _ring_bounds(n: int, ws: int) -> List[int]:
 
 
 def _ring_reduce_scatter(
-    ctx: _CommCtx, flat: np.ndarray, op: ReduceOp, tag_base: int = 0
+    ctx: _CommCtx,
+    flat: np.ndarray,
+    op: ReduceOp,
+    tag_base: int = 0,
+    ring: Optional[List[int]] = None,
 ) -> np.ndarray:
     """In-place ring reduce-scatter phase: after ws-1 duplex steps, this
     rank's chunk (``_ring_bounds`` chunk ``rank``) holds the full reduction;
     returns a view of it.  The schedule is shifted by one vs the textbook
-    ring so rank r ends up owning chunk r (the conventional contract)."""
-    ws, rank = ctx.world_size, ctx.rank
+    ring so rank r ends up owning chunk r (the conventional contract).
+
+    ``ring`` (global ranks in ring order; default = all ranks) restricts
+    the ring to a subset — the hierarchical leader ring.  The flat default
+    compiles to the identical schedule (position == rank), so the legacy
+    wire behavior is byte-for-byte unchanged."""
+    if ring is None:
+        ring = list(range(ctx.world_size))
+    ws = len(ring)
     if ws == 1:
         return flat
     mesh = ctx.mesh
     assert mesh is not None
-    right = (rank + 1) % ws
-    left = (rank - 1) % ws
+    pos = ring.index(ctx.rank)
+    right = ring[(pos + 1) % ws]
+    left = ring[(pos - 1) % ws]
     deadline = ctx.deadline()
     bounds = _ring_bounds(flat.size, ws)
 
@@ -1731,8 +2494,8 @@ def _ring_reduce_scatter(
     scratch = np.empty(bounds[1], dtype=flat.dtype)
     itemsize = flat.dtype.itemsize
     for step in range(ws - 1):
-        send_idx = (rank - step - 1) % ws
-        recv_idx = (rank - step - 2) % ws
+        send_idx = (pos - step - 1) % ws
+        recv_idx = (pos - step - 2) % ws
         send_chunk = chunk(send_idx)
         recv_chunk = chunk(recv_idx)
         recv_buf = scratch[: recv_chunk.size]
@@ -1752,11 +2515,15 @@ def _ring_reduce_scatter(
             [(left, tag_base + 1000 + step, _bytes_view(recv_buf), _reduce_part)],
             deadline,
         )
-    return chunk(rank)
+    return chunk(pos)
 
 
 def _ring_allreduce(
-    ctx: _CommCtx, flat: np.ndarray, op: ReduceOp, tag_base: int = 0
+    ctx: _CommCtx,
+    flat: np.ndarray,
+    op: ReduceOp,
+    tag_base: int = 0,
+    ring: Optional[List[int]] = None,
 ) -> None:
     """In-place bandwidth-optimal ring allreduce.
 
@@ -1765,31 +2532,147 @@ def _ring_allreduce(
     at world size 2, where both directions share one socket pair).  Each
     chunk's frame is lane-striped by ``exchange``; the per-element reduction
     order is fixed by the chunk schedule alone, so lane count never changes
-    the bits.
+    the bits.  ``ring`` restricts to a rank subset (the hierarchical leader
+    ring); the default is the byte-for-byte legacy flat ring.
     """
+    if ring is None:
+        ring = list(range(ctx.world_size))
+    ws = len(ring)
+    if ws == 1:
+        return
     mesh = ctx.mesh
     assert mesh is not None
-    ws, rank = ctx.world_size, ctx.rank
-    right = (rank + 1) % ws
-    left = (rank - 1) % ws
+    pos = ring.index(ctx.rank)
+    right = ring[(pos + 1) % ws]
+    left = ring[(pos - 1) % ws]
     deadline = ctx.deadline()
 
-    _ring_reduce_scatter(ctx, flat, op, tag_base)
+    _ring_reduce_scatter(ctx, flat, op, tag_base, ring=ring)
     bounds = _ring_bounds(flat.size, ws)
 
     def chunk(i: int) -> np.ndarray:
         i %= ws
         return flat[bounds[i] : bounds[i + 1]]
 
-    # allgather phase: rank r starts owning reduced chunk r
+    # allgather phase: ring position p starts owning reduced chunk p
     for step in range(ws - 1):
-        send_idx = (rank - step) % ws
-        recv_idx = (rank - step - 1) % ws
+        send_idx = (pos - step) % ws
+        recv_idx = (pos - step - 1) % ws
         mesh.exchange(
             [(right, tag_base + 2000 + step, _bytes_view(chunk(send_idx)))],
             [(left, tag_base + 2000 + step, _bytes_view(chunk(recv_idx)))],
             deadline,
         )
+
+
+def _hier_allreduce(
+    ctx: _CommCtx, flat: np.ndarray, op: ReduceOp, tag_base: int = 0
+) -> None:
+    """Two-level in-place allreduce over the discovered host topology:
+    intra-host shared-memory reduce (fixed ascending-rank order) → striped
+    multi-lane cross-host ring among the per-host leaders → intra-host
+    broadcast.  Each byte crosses the DCN once per HOST instead of once per
+    replica; results are deterministic (fixed reduction order) and
+    bit-identical across lane counts at a fixed topology, though not
+    bit-identical to the flat ring (different reduction ORDER — allclose)."""
+    mesh = ctx.mesh
+    assert mesh is not None and mesh.topo is not None
+    topo = mesh.topo
+    deadline = ctx.deadline()
+    mesh.shm_reduce(flat, op, deadline)
+    if topo.is_leader and len(topo.leader_ring) > 1:
+        _ring_allreduce(ctx, flat, op, tag_base, ring=topo.leader_ring)
+    mesh.shm_bcast(flat, deadline)
+
+
+def _hier_allgather_sync(
+    ctx: _CommCtx, array: np.ndarray, tag: int
+) -> List[np.ndarray]:
+    """Hierarchical allgather: shm-gather each host's buffers to its
+    leader, exchange whole host BLOCKS among leaders (each byte crosses the
+    DCN once per host pair, not once per replica pair), then shm-broadcast
+    the assembled result.  Same value contract as the flat path: a list
+    indexed by global rank, own entry aliasing the input."""
+    mesh = ctx.mesh
+    assert mesh is not None and mesh.topo is not None
+    topo = mesh.topo
+    ws, rank = ctx.world_size, ctx.rank
+    deadline = ctx.deadline()
+    n = array.nbytes
+    total = np.empty(ws * n, dtype=np.uint8)
+
+    gathered = mesh.shm_gather(array, deadline)
+    if topo.is_leader:
+        if len(topo.leader_ring) > 1:
+            assert gathered is not None
+            my_block = np.concatenate(
+                [
+                    np.frombuffer(_bytes_view(a), dtype=np.uint8)
+                    for a in gathered
+                ]
+            )
+            other = [g for g in topo.hosts if rank not in g]
+            blocks = {g[0]: np.empty(len(g) * n, dtype=np.uint8) for g in other}
+            sends = [
+                (g[0], 9000 + tag, _bytes_view(my_block)) for g in other
+            ]
+            recvs = [
+                (g[0], 9000 + tag, _bytes_view(blocks[g[0]])) for g in other
+            ]
+            mesh.exchange(sends, recvs, deadline)
+            for g in other:
+                block = blocks[g[0]]
+                for k, member in enumerate(g):
+                    total[member * n : (member + 1) * n] = block[
+                        k * n : (k + 1) * n
+                    ]
+        assert gathered is not None
+        for k, member in enumerate(topo.local):
+            total[member * n : (member + 1) * n] = _bytes_view(gathered[k])
+    mesh.shm_bcast(total, deadline)
+
+    out: List[np.ndarray] = []
+    for p in range(ws):
+        if p == rank:
+            out.append(array)
+        else:
+            out.append(
+                total[p * n : (p + 1) * n]
+                .view(array.dtype)
+                .reshape(array.shape)
+                .copy()
+            )
+    return out
+
+
+def _hier_broadcast_sync(
+    ctx: _CommCtx, arrays: List[np.ndarray], root: int
+) -> List[np.ndarray]:
+    """Hierarchical broadcast: the root pushes each buffer once per OTHER
+    host (to its leader); delivery inside every host is a shared-memory
+    broadcast.  Wire bytes drop by the local-group factor vs the flat
+    root-to-every-peer fanout."""
+    mesh = ctx.mesh
+    assert mesh is not None and mesh.topo is not None
+    topo = mesh.topo
+    out = [np.ascontiguousarray(a) for a in arrays]
+    deadline = ctx.deadline()
+    root_local = root in topo.local
+    src_idx = topo.local.index(root) if root_local else 0
+    for i, a in enumerate(out):
+        view = _bytes_view(a)
+        if ctx.rank == root:
+            other_leads = [g[0] for g in topo.hosts if root not in g]
+            if other_leads:
+                mesh.exchange(
+                    [(lead, 3000 + i, view) for lead in other_leads],
+                    [],
+                    deadline,
+                )
+        elif topo.is_leader and not root_local:
+            mesh.exchange([], [(root, 3000 + i, view)], deadline)
+        mesh.shm_bcast(a, deadline, src_idx=src_idx)
+    return out
 
 
 def _broadcast_sync(ctx: _CommCtx, arrays: List[np.ndarray], root: int) -> List[np.ndarray]:
@@ -1799,6 +2682,8 @@ def _broadcast_sync(ctx: _CommCtx, arrays: List[np.ndarray], root: int) -> List[
         return out
     mesh = ctx.mesh
     assert mesh is not None
+    if mesh.topo is not None:
+        return _hier_broadcast_sync(ctx, out, root)
     deadline = ctx.deadline()
     if ctx.rank == root:
         for i, a in enumerate(out):
@@ -1955,6 +2840,20 @@ class FakeCommunicatorWrapper(Communicator):
     def lane_stats(self) -> Dict[str, object]:
         return self._comm.lane_stats()
 
+    def hier_topology(self) -> Optional[Dict[str, object]]:
+        return self._comm.hier_topology()
+
+    def intra_reduce(self, flat, op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._wrap(self._comm.intra_reduce(flat, op))  # type: ignore[attr-defined]
+
+    def intra_broadcast(self, flat, count: int, dtype=np.float32) -> Work:
+        return self._wrap(
+            self._comm.intra_broadcast(flat, count, dtype)  # type: ignore[attr-defined]
+        )
+
+    def leader_comm(self) -> "Communicator":
+        return self._comm.leader_comm()  # type: ignore[attr-defined]
+
     def barrier(self) -> Work:
         return self._wrap(self._comm.barrier())
 
@@ -2020,6 +2919,18 @@ class ManagedCommunicator(Communicator):
 
     def lane_stats(self) -> Dict[str, object]:
         return self._manager._comm.lane_stats()
+
+    def hier_topology(self) -> Optional[Dict[str, object]]:
+        return self._manager._comm.hier_topology()
+
+    def intra_reduce(self, flat, op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._manager._comm.intra_reduce(flat, op)
+
+    def intra_broadcast(self, flat, count: int, dtype=np.float32) -> Work:
+        return self._manager._comm.intra_broadcast(flat, count, dtype)
+
+    def leader_comm(self) -> "Communicator":
+        return self._manager._comm.leader_comm()
 
     def barrier(self) -> Work:
         return self._manager._comm.barrier()

@@ -493,6 +493,20 @@ class Manager:
                     comm_lane_rx_bytes=prev_lane_stats.get("lane_rx_bytes"),
                     comm_lane_stalls=prev_lane_stats.get("lane_stalls"),
                 )
+                if prev_lane_stats.get("topo_hosts"):
+                    # hierarchical-topology counters of the outgoing epoch:
+                    # host grouping + shared-memory bytes that never touched
+                    # the DCN (the cross-host byte reduction, observable)
+                    quorum_extra.update(
+                        comm_topo_hosts=prev_lane_stats.get("topo_hosts"),
+                        comm_topo_local_world=prev_lane_stats.get(
+                            "topo_local_world"
+                        ),
+                        comm_shm_bytes=(
+                            int(prev_lane_stats.get("shm_tx_bytes", 0))
+                            + int(prev_lane_stats.get("shm_rx_bytes", 0))
+                        ),
+                    )
             self.quorum_logger.info("", extra=quorum_extra)
             store_prefixed_addr = (
                 f"{quorum.store_address}/torchft/{quorum_id}/{self._group_rank}"
@@ -530,6 +544,12 @@ class Manager:
                 timings["ring_lanes"] = float(fresh_lane_stats["lanes"])
                 timings["ring_stripe_floor_bytes"] = float(
                     fresh_lane_stats.get("stripe_floor_bytes", 0)
+                )
+            if fresh_lane_stats.get("topo_hosts"):
+                # topology of the fresh epoch, next to the phase wall-times
+                timings["topo_hosts"] = float(fresh_lane_stats["topo_hosts"])
+                timings["topo_local_world"] = float(
+                    fresh_lane_stats.get("topo_local_world", 1)
                 )
 
         if allow_heal:

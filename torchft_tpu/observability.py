@@ -57,6 +57,11 @@ _ATTR_KEYS = (
     "comm_lane_tx_bytes",
     "comm_lane_rx_bytes",
     "comm_lane_stalls",
+    # hierarchical-topology counters (torchft_quorums; host grouping +
+    # shared-memory transport bytes of the outgoing epoch)
+    "comm_topo_hosts",
+    "comm_topo_local_world",
+    "comm_shm_bytes",
     # heal-path counters (torchft_heals; striped checkpoint recovery)
     "heal_bytes",
     "heal_duration_s",
