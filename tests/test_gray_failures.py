@@ -1,0 +1,732 @@
+"""Gray-failure resilience tests.
+
+The contract of ISSUE 4: transient faults (flaky links, one slow NIC, a
+partition) are survived IN-epoch or shed proactively, instead of being
+treated as crashes — a lane reset re-dials and replays (bit-identical
+results), a lane whose re-dial fails fails over to the surviving lanes,
+the epoch poisons only when EVERY lane to a peer is dead, idempotent
+control-plane rpcs ride out one connection blip, and a persistently slow
+replica is flagged from heartbeat comm-health and (behind
+``TORCHFT_EVICT_SLOW``) evicted from the next quorum.
+"""
+
+import random
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List
+
+import numpy as np
+import pytest
+
+from torchft_tpu.communicator import (
+    CommunicatorAborted,
+    CommunicatorError,
+    ReduceOp,
+    TCPCommunicator,
+    _FaultProgram,
+    _recv_exact,
+    parse_fault_spec,
+)
+from torchft_tpu.store import StoreServer
+from torchft_tpu.wire import (
+    CommHealth,
+    MsgType,
+    Reader,
+    RpcClient,
+    Writer,
+    connect,
+    recv_frame,
+    send_frame,
+)
+
+
+@pytest.fixture()
+def store():
+    server = StoreServer("127.0.0.1:0")
+    yield server
+    server.shutdown()
+
+
+def _run_ranks(
+    store: StoreServer,
+    world_size: int,
+    fn: Callable[[TCPCommunicator, int], object],
+    prefix: str,
+    timeout_s: float = 30.0,
+) -> List[object]:
+    def _one(rank: int) -> object:
+        comm = TCPCommunicator(timeout_s=timeout_s)
+        comm.configure(
+            f"127.0.0.1:{store.port}/{prefix}",
+            replica_id=f"rep_{rank}",
+            rank=rank,
+            world_size=world_size,
+        )
+        try:
+            return fn(comm, rank)
+        finally:
+            comm.shutdown()
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        return list(pool.map(_one, range(world_size)))
+
+
+# ---------------------------------------------------------------------------
+# fault-program parsing
+# ---------------------------------------------------------------------------
+
+
+class TestFaultSpec:
+    def test_parse_terms(self) -> None:
+        prog = parse_fault_spec("loss:0.01,reset:0.002,stall:0.1:250")
+        assert prog is not None and prog.active()
+        assert prog.loss == pytest.approx(0.01)
+        assert prog.reset == pytest.approx(0.002)
+        assert prog.stall_p == pytest.approx(0.1)
+        assert prog.stall_ms == pytest.approx(250.0)
+
+    def test_parse_partition_and_self(self) -> None:
+        prog = parse_fault_spec("partition:0+2")
+        assert prog is not None
+        assert prog.partitions(0, 1) and prog.partitions(2, 1)
+        assert not prog.partitions(0, 2) and not prog.partitions(1, 3)
+        prog = parse_fault_spec("partition:self")
+        # 'self' cuts the ARMED rank (whatever it is) from every peer
+        assert prog.partitions(5, 1) and prog.partitions(0, 2)
+
+    def test_empty_disables(self) -> None:
+        assert parse_fault_spec(None) is None
+        assert parse_fault_spec("  ") is None
+
+    def test_bad_spec_is_loud(self) -> None:
+        with pytest.raises(CommunicatorError, match="TORCHFT_NET_FAULTS"):
+            parse_fault_spec("loss")
+        with pytest.raises(CommunicatorError, match="TORCHFT_NET_FAULTS"):
+            parse_fault_spec("jitter:0.5")
+        with pytest.raises(CommunicatorError, match="TORCHFT_NET_FAULTS"):
+            parse_fault_spec("loss:lots")
+
+
+# ---------------------------------------------------------------------------
+# in-epoch lane recovery
+# ---------------------------------------------------------------------------
+
+
+class TestLaneRecovery:
+    def test_reset_mid_allreduce_recovers_in_epoch(
+        self, store, monkeypatch
+    ) -> None:
+        """A deterministic connection reset mid-collective re-dials the lane,
+        replays the swallowed sub-frames, and the result is bit-identical —
+        the epoch is NEVER poisoned."""
+        monkeypatch.setenv("TORCHFT_RING_LANES", "2")
+        monkeypatch.setenv("TORCHFT_RING_FRAME_KB", "64")
+        n = 1_000_003
+        rng = np.random.default_rng(3)
+        inputs = [rng.normal(size=n).astype(np.float32) for _ in range(2)]
+        stats = {}
+
+        def _fn(comm: TCPCommunicator, rank: int) -> np.ndarray:
+            if rank == 0:
+                comm.arm_faults("reset_once:2")
+            out = np.asarray(
+                comm.allreduce(inputs[rank].copy(), ReduceOp.SUM).wait(
+                    timeout=30.0
+                )
+            )
+            assert comm.errored() is None, comm.errored()
+            stats[rank] = comm.lane_stats()
+            return out
+
+        got = _run_ranks(store, 2, _fn, prefix="grayreset")
+        np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(got[1]))
+        np.testing.assert_allclose(
+            np.asarray(got[0]), inputs[0] + inputs[1], rtol=1e-6
+        )
+        # the reset was recovered by a reconnect (both endpoints count it)
+        assert stats[0]["lane_reconnects"] + stats[1]["lane_reconnects"] >= 1
+        assert stats[0]["faults_injected"] >= 1
+
+    def test_failed_redial_fails_over_to_surviving_lane(
+        self, store, monkeypatch
+    ) -> None:
+        """With re-dial disabled (TORCHFT_LANE_RETRIES=0) a reset lane's
+        outstanding sub-frames re-route onto a surviving lane — results stay
+        bit-identical, later collectives keep working, the epoch stays
+        healthy."""
+        monkeypatch.setenv("TORCHFT_RING_LANES", "2")
+        monkeypatch.setenv("TORCHFT_RING_FRAME_KB", "64")
+        monkeypatch.setenv("TORCHFT_LANE_RETRIES", "0")
+        n = 1_000_003
+        rng = np.random.default_rng(4)
+        inputs = [rng.normal(size=n).astype(np.float32) for _ in range(2)]
+        stats = {}
+
+        def _fn(comm: TCPCommunicator, rank: int) -> List[np.ndarray]:
+            if rank == 1:
+                comm.arm_faults("reset_once:1")
+            outs = [
+                np.asarray(
+                    comm.allreduce(inputs[rank].copy(), ReduceOp.SUM).wait(
+                        timeout=30.0
+                    )
+                )
+                for _ in range(2)  # the epoch survives PAST the failover
+            ]
+            assert comm.errored() is None, comm.errored()
+            stats[rank] = comm.lane_stats()
+            return outs
+
+        got = _run_ranks(store, 2, _fn, prefix="grayfailover")
+        for a, b in zip(got[0], got[1]):
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_allclose(a, inputs[0] + inputs[1], rtol=1e-6)
+        assert stats[0]["lane_failovers"] + stats[1]["lane_failovers"] >= 1
+        assert stats[0]["dead_lanes"] >= 1 and stats[1]["dead_lanes"] >= 1
+
+    def test_all_lanes_dead_poisons_exactly_once(
+        self, store, monkeypatch
+    ) -> None:
+        """A peer death kills EVERY lane: recovery must not mask it — the
+        survivor's op fails and the epoch latches exactly one poison."""
+        monkeypatch.setenv("TORCHFT_RING_LANES", "2")
+        monkeypatch.setenv("TORCHFT_RING_FRAME_KB", "64")
+        monkeypatch.setenv("TORCHFT_LANE_RETRIES", "1")
+        monkeypatch.setenv("TORCHFT_LANE_BACKOFF_MS", "20")
+        barrier = threading.Barrier(2)
+
+        def _fn(comm: TCPCommunicator, rank: int) -> object:
+            barrier.wait()
+            if rank == 1:
+                comm.abort("injected peer death")
+                return None
+            work = comm.allreduce(
+                np.ones(1 << 20, dtype=np.float32), ReduceOp.SUM
+            )
+            err = work.exception(timeout=30.0)
+            assert err is not None
+            first = comm.errored()
+            assert first is not None
+            # the latched poison is sticky: a second op fails with the SAME
+            # error object, not a fresh abort
+            err2 = comm.allreduce(np.ones(8, dtype=np.float32)).exception(
+                timeout=5.0
+            )
+            assert err2 is first
+            return None
+
+        _run_ranks(store, 2, _fn, prefix="graypeerdeath")
+
+    def test_partition_mask_blackholes_the_link(
+        self, store, monkeypatch
+    ) -> None:
+        """A partition mask blackholes frames both ways: the collective
+        cannot complete and the op times out (then poisons) instead of
+        silently mis-delivering."""
+        monkeypatch.setenv("TORCHFT_RING_LANES", "1")
+
+        def _fn(comm: TCPCommunicator, rank: int) -> object:
+            if rank == 0:
+                comm.arm_faults("partition:self")
+            work = comm.allreduce(np.ones(1 << 18, dtype=np.float32))
+            err = work.exception(timeout=30.0)
+            assert err is not None, "partitioned collective must not succeed"
+            return None
+
+        _run_ranks(store, 2, _fn, prefix="graypartition", timeout_s=3.0)
+
+
+# ---------------------------------------------------------------------------
+# abort responsiveness (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestAbortResponsiveness:
+    def test_recv_exact_honors_abort_quickly(self) -> None:
+        a, b = socket.socketpair()
+        aborted = threading.Event()
+        timer = threading.Timer(0.3, aborted.set)
+        timer.start()
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(CommunicatorAborted):
+                _recv_exact(a, 16, aborted, timeout_s=30.0)
+        finally:
+            timer.cancel()
+            a.close()
+            b.close()
+        # an abort must propagate in ~one poll slice, not one op timeout
+        assert time.monotonic() - t0 < 3.0
+
+    def test_recv_exact_still_times_out(self) -> None:
+        a, b = socket.socketpair()
+        try:
+            with pytest.raises(TimeoutError):
+                _recv_exact(a, 16, threading.Event(), timeout_s=0.4)
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# control-plane retry (RpcClient + connect)
+# ---------------------------------------------------------------------------
+
+
+def _drop_then_serve(drops: int):
+    """A server that closes the first ``drops`` connections after reading
+    one frame, then answers properly; returns (addr, shutdown_fn)."""
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(8)
+    port = listener.getsockname()[1]
+    stop = threading.Event()
+    seen = [0]
+
+    def _serve() -> None:
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            try:
+                msg_type, _r = recv_frame(conn)
+                seen[0] += 1
+                if seen[0] <= drops:
+                    conn.close()
+                    continue
+                send_frame(conn, MsgType.STORE_OK, Writer().u8(1).payload())
+            except OSError:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    threading.Thread(target=_serve, daemon=True).start()
+
+    def _shutdown() -> None:
+        stop.set()
+        listener.close()
+
+    return f"127.0.0.1:{port}", _shutdown
+
+
+class TestRpcRetry:
+    def test_idempotent_call_survives_one_dropped_connection(self) -> None:
+        addr, shutdown = _drop_then_serve(drops=1)
+        try:
+            client = RpcClient(addr, connect_timeout=5.0)
+            msg_type, r = client.call(
+                MsgType.STORE_EXISTS, b"", timeout=5.0, idempotent=True
+            )
+            assert msg_type == MsgType.STORE_OK
+            client.close()
+        finally:
+            shutdown()
+
+    def test_idempotent_call_does_not_survive_two_drops(self) -> None:
+        addr, shutdown = _drop_then_serve(drops=2)
+        try:
+            client = RpcClient(addr, connect_timeout=5.0)
+            with pytest.raises((ConnectionError, OSError)):
+                client.call(
+                    MsgType.STORE_EXISTS, b"", timeout=5.0, idempotent=True
+                )
+            client.close()
+        finally:
+            shutdown()
+
+    def test_non_idempotent_call_never_retries(self) -> None:
+        addr, shutdown = _drop_then_serve(drops=1)
+        try:
+            client = RpcClient(addr, connect_timeout=5.0)
+            with pytest.raises((ConnectionError, OSError)):
+                client.call(MsgType.STORE_SET, b"", timeout=5.0)
+            client.close()
+        finally:
+            shutdown()
+
+
+class TestConnectBackoff:
+    def test_connect_rides_out_a_restarting_server(self) -> None:
+        """The dial target comes up ~0.4 s late; connect() must retry with
+        backoff inside its budget instead of dying at the first refusal."""
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        server_sock: List[socket.socket] = []
+
+        def _late_bind() -> None:
+            time.sleep(0.4)
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", port))
+            s.listen(1)
+            server_sock.append(s)
+
+        t = threading.Thread(target=_late_bind, daemon=True)
+        t.start()
+        sock = connect(f"127.0.0.1:{port}", timeout=10.0, retries=6)
+        sock.close()
+        t.join()
+        for s in server_sock:
+            s.close()
+
+    def test_connect_without_retries_fails_fast(self) -> None:
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            connect(f"127.0.0.1:{port}", timeout=5.0, retries=0)
+        assert time.monotonic() - t0 < 2.0
+
+
+# ---------------------------------------------------------------------------
+# heartbeat comm-health + straggler eviction
+# ---------------------------------------------------------------------------
+
+
+class TestCommHealthWire:
+    def test_roundtrip(self) -> None:
+        h = CommHealth(
+            stalls=7, reconnects=2, failovers=1, faults=3,
+            tx_bytes=123456, rx_bytes=654321,
+        )
+        w = Writer()
+        h.encode(w)
+        assert CommHealth.decode(Reader(w.payload())) == h
+
+    def test_heartbeat_tail_is_optional(self) -> None:
+        # a legacy heartbeat (replica id only) and a health-carrying one
+        # both parse on the server path
+        from torchft_tpu.lighthouse import LighthouseServer, LighthouseClient
+
+        server = LighthouseServer(bind="127.0.0.1:0", min_replicas=1)
+        try:
+            client = LighthouseClient(
+                server.local_address(), connect_timeout=5.0
+            )
+            client.heartbeat("legacy")
+            client.heartbeat(
+                "modern", health=CommHealth(stalls=5, tx_bytes=10)
+            )
+            client.heartbeat(
+                "modern", health=CommHealth(stalls=9, tx_bytes=20)
+            )
+            status = client.status()
+            assert "legacy" in status["heartbeats"]
+            assert "modern" in status["health"]
+            assert "legacy" not in status["health"]
+            client.close()
+        finally:
+            server.shutdown()
+
+
+class TestStragglerEviction:
+    def _beat(self, state, rid, stalls, ts):
+        from torchft_tpu.lighthouse import note_health
+
+        note_health(state, rid, CommHealth(stalls=stalls), ts)
+
+    def test_outlier_flagged_and_evicted(self, monkeypatch) -> None:
+        from torchft_tpu.lighthouse import (
+            LighthouseConfig,
+            QuorumMember,
+            _MemberDetails,
+            _State,
+            quorum_compute,
+        )
+
+        monkeypatch.setenv("TORCHFT_EVICT_SLOW", "1")
+        monkeypatch.setenv("TORCHFT_EVICT_PERSIST", "2")
+        monkeypatch.setenv("TORCHFT_EVICT_MIN_STALL_RATE", "5")
+        state = _State()
+        now = 1000.0
+        # 6 beats, 0.1 s apart: the victim accrues 100 stalls/beat, the
+        # healthy pair none
+        for i in range(6):
+            ts = now + 0.1 * i
+            self._beat(state, "rep_a", 0, ts)
+            self._beat(state, "rep_b", 0, ts)
+            self._beat(state, "rep_slow", 100 * (i + 1), ts)
+        assert state.health["rep_slow"].flagged
+        assert not state.health["rep_a"].flagged
+
+        ts = now + 1.0
+        for rid in ("rep_a", "rep_b", "rep_slow"):
+            state.heartbeats[rid] = ts
+            state.participants[rid] = _MemberDetails(
+                joined=ts, member=QuorumMember(replica_id=rid)
+            )
+        cfg = LighthouseConfig(min_replicas=2, join_timeout_ms=0)
+        members, reason = quorum_compute(ts, state, cfg)
+        assert members is not None, reason
+        assert [m.replica_id for m in members] == ["rep_a", "rep_b"]
+        assert state.evicted_now == ["rep_slow"]
+        assert "evicting slow" in reason
+
+    def test_eviction_never_breaks_quorum_floor(self, monkeypatch) -> None:
+        """A flagged straggler is NOT evicted when shedding it would drop
+        the quorum below min_replicas — a gray node beats no fleet."""
+        from torchft_tpu.lighthouse import (
+            LighthouseConfig,
+            QuorumMember,
+            _MemberDetails,
+            _State,
+            quorum_compute,
+        )
+
+        monkeypatch.setenv("TORCHFT_EVICT_SLOW", "1")
+        monkeypatch.setenv("TORCHFT_EVICT_PERSIST", "2")
+        monkeypatch.setenv("TORCHFT_EVICT_MIN_STALL_RATE", "5")
+        state = _State()
+        now = 1000.0
+        for i in range(6):
+            ts = now + 0.1 * i
+            self._beat(state, "rep_a", 0, ts)
+            self._beat(state, "rep_b", 0, ts)
+            self._beat(state, "rep_slow", 100 * (i + 1), ts)
+        assert state.health["rep_slow"].flagged
+        ts = now + 1.0
+        for rid in ("rep_a", "rep_b", "rep_slow"):
+            state.heartbeats[rid] = ts
+            state.participants[rid] = _MemberDetails(
+                joined=ts, member=QuorumMember(replica_id=rid)
+            )
+        cfg = LighthouseConfig(min_replicas=3, join_timeout_ms=0)
+        members, reason = quorum_compute(ts, state, cfg)
+        assert members is not None, reason
+        assert len(members) == 3 and state.evicted_now == []
+
+    def test_disabled_by_default(self, monkeypatch) -> None:
+        from torchft_tpu.lighthouse import (
+            LighthouseConfig,
+            QuorumMember,
+            _MemberDetails,
+            _State,
+            quorum_compute,
+        )
+
+        monkeypatch.delenv("TORCHFT_EVICT_SLOW", raising=False)
+        monkeypatch.setenv("TORCHFT_EVICT_PERSIST", "2")
+        monkeypatch.setenv("TORCHFT_EVICT_MIN_STALL_RATE", "5")
+        state = _State()
+        now = 1000.0
+        for i in range(6):
+            ts = now + 0.1 * i
+            self._beat(state, "rep_a", 0, ts)
+            self._beat(state, "rep_b", 0, ts)
+            self._beat(state, "rep_slow", 100 * (i + 1), ts)
+        assert state.health["rep_slow"].flagged  # detection is always on
+        ts = now + 1.0
+        for rid in ("rep_a", "rep_b", "rep_slow"):
+            state.heartbeats[rid] = ts
+            state.participants[rid] = _MemberDetails(
+                joined=ts, member=QuorumMember(replica_id=rid)
+            )
+        cfg = LighthouseConfig(min_replicas=2, join_timeout_ms=0)
+        members, _ = quorum_compute(ts, state, cfg)
+        assert members is not None and len(members) == 3  # no eviction
+
+
+class TestPartitionQuorum:
+    def test_majority_side_forms_shrink_only_quorum(self) -> None:
+        """With the minority side's heartbeats gone stale (a partitioned
+        node loses the control plane too), the majority side's shrink-only
+        re-request forms a smaller quorum; the minority can never reach the
+        anti-split-brain bar."""
+        from torchft_tpu.lighthouse import (
+            LighthouseConfig,
+            Quorum,
+            QuorumMember,
+            _MemberDetails,
+            _State,
+            quorum_compute,
+        )
+
+        state = _State()
+        now = 1000.0
+        prev = [QuorumMember(replica_id=f"rep_{i}") for i in range(3)]
+        state.prev_quorum = Quorum(quorum_id=3, participants=prev)
+        # majority side re-registers shrink-only; the partitioned rep_2's
+        # heartbeat is stale
+        for rid in ("rep_0", "rep_1"):
+            state.heartbeats[rid] = now
+            state.participants[rid] = _MemberDetails(
+                joined=now,
+                member=QuorumMember(replica_id=rid, shrink_only=True),
+            )
+        state.heartbeats["rep_2"] = now - 60.0
+        cfg = LighthouseConfig(min_replicas=2, join_timeout_ms=10_000)
+        members, reason = quorum_compute(now, state, cfg)
+        assert members is not None, reason
+        assert [m.replica_id for m in members] == ["rep_0", "rep_1"]
+        # the minority side alone can never clear the majority bar
+        minority = _State()
+        minority.prev_quorum = Quorum(quorum_id=3, participants=prev)
+        for rid in ("rep_0", "rep_1", "rep_2"):
+            minority.heartbeats[rid] = now  # it still SEES everyone as alive
+        minority.participants["rep_2"] = _MemberDetails(
+            joined=now, member=QuorumMember(replica_id="rep_2")
+        )
+        members, _ = quorum_compute(now, minority, cfg)
+        assert members is None
+
+
+# ---------------------------------------------------------------------------
+# chaos controller satellites
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.alive = True
+        self.injected: List[str] = []
+
+    def supports(self, failure) -> bool:
+        return self.alive
+
+    def inject(self, failure, **kw) -> None:
+        self.injected.append(failure.value)
+        self.alive = False
+
+    def progress(self) -> int:
+        return 0
+
+
+class TestProcessPlaneGrayInjection:
+    def test_fault_program_rides_the_spawn_env(self) -> None:
+        """Process plane: NET_FLAKY/SLOW_NIC/PARTITION arm by writing the
+        fault program into the group's spawn env (landing on the next
+        restart); spec=None disarms."""
+        from torchft_tpu.chaos import Failure, ProcessReplica
+
+        class _Spec:
+            def __init__(self, gid: int) -> None:
+                self.replica_group_id = gid
+                self.env: dict = {}
+
+        class _FakeSupervisor:
+            def __init__(self) -> None:
+                self._specs = [_Spec(0), _Spec(1)]
+                self.kills: List[int] = []
+
+            def kill(self, gid: int, sig: int = 9) -> bool:
+                self.kills.append(gid)
+                return True
+
+        sup = _FakeSupervisor()
+        rep = ProcessReplica("g1", sup, replica_group_id=1)
+        assert rep.supports(Failure.NET_FLAKY)
+        rep.inject(Failure.NET_FLAKY)
+        assert sup._specs[1].env["TORCHFT_NET_FAULTS"] == "loss:0.01,reset:0.002"
+        assert sup._specs[0].env == {}
+        assert sup.kills == [1]  # bounced so it comes up flaky now
+        rep.inject(Failure.SLOW_NIC, spec="stall:0.9:100", restart=False)
+        assert sup._specs[1].env["TORCHFT_NET_FAULTS"] == "stall:0.9:100"
+        assert sup.kills == [1]
+        rep.inject(Failure.NET_FLAKY, spec=None, restart=False)
+        assert "TORCHFT_NET_FAULTS" not in sup._specs[1].env
+
+
+class TestRunPoisson:
+    def test_seeded_rng_is_reproducible(self) -> None:
+        from torchft_tpu.chaos import ChaosController, Failure
+
+        def _run(seed: int) -> List[str]:
+            reps = [_FakeReplica(f"r{i}") for i in range(3)]
+            ctl = ChaosController(reps)
+            ctl.run_poisson(
+                [Failure.KILL, Failure.COMM_ABORT],
+                mtbf_s=0.001,
+                stop=threading.Event(),
+                rng=random.Random(seed),
+            )
+            return [e.victim for e in ctl.events]
+
+        assert _run(7) == _run(7)
+        assert len(_run(7)) == 3  # every victim died, loop ended cleanly
+
+    def test_stops_cleanly_when_every_victim_is_dead(self) -> None:
+        from torchft_tpu.chaos import ChaosController, Failure
+
+        reps = [_FakeReplica("r0")]
+        ctl = ChaosController(reps, rng=random.Random(1))
+        stop = threading.Event()
+        t0 = time.monotonic()
+        counts = ctl.run_poisson([Failure.KILL], mtbf_s=0.001, stop=stop)
+        # one injection killed the only victim; the loop must END, not spin
+        # or raise, even though stop was never set
+        assert counts[Failure.KILL] == 1
+        assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# fleet drills (chaos -> manager -> lighthouse, end to end)
+# ---------------------------------------------------------------------------
+
+
+class TestGrayDrills:
+    def test_net_flaky_fleet_recovers_in_epoch(self) -> None:
+        """3-replica fleet under loss+resets on every link: all steps
+        commit with ZERO quorum reconfigurations and nonzero in-epoch lane
+        reconnects (the acceptance drill, scaled for CI)."""
+        from torchft_tpu.drill import gray_failure_drill
+
+        res = gray_failure_drill(
+            num_replicas=3,
+            steps=6,
+            mode="net_flaky",
+            fault_spec="loss:0.05,reset:0.02",
+            lanes=2,
+            payload_elems=300_000,
+            arm_at_step=2,
+            timeout_s=20.0,
+        )
+        assert res["quorum_reconfigs"] == 0
+        assert res["faults_injected"] > 0
+
+    @pytest.mark.slow
+    def test_slow_nic_replica_is_evicted(self) -> None:
+        from torchft_tpu.drill import gray_failure_drill
+
+        res = gray_failure_drill(
+            num_replicas=3,
+            steps=8,
+            mode="slow_nic",
+            lanes=2,
+            payload_elems=300_000,
+            arm_at_step=2,
+            timeout_s=15.0,
+            evict_persist=2,
+        )
+        assert res["victim_excluded"] and res["evictions_total"] >= 1
+        # fleet step time recovers once the straggler is shed
+        assert (
+            res["step_time_recovered_s"]
+            <= 1.2 * res["step_time_clean_s"]
+        )
+
+    @pytest.mark.slow
+    def test_partitioned_replica_is_shed_by_majority(self) -> None:
+        from torchft_tpu.drill import gray_failure_drill
+
+        res = gray_failure_drill(
+            num_replicas=3,
+            steps=6,
+            mode="partition",
+            lanes=2,
+            payload_elems=200_000,
+            arm_at_step=2,
+            timeout_s=8.0,
+        )
+        assert res["victim_excluded"]

@@ -71,6 +71,11 @@ _LAZY = {
     "ChaosController": ("torchft_tpu.chaos", "ChaosController"),
     "Failure": ("torchft_tpu.chaos", "Failure"),
     "rehearse": ("torchft_tpu.parallel.rehearsal", "rehearse"),
+    # gray-failure surface: fault-program parsing (TORCHFT_NET_FAULTS /
+    # TCPCommunicator.arm_faults) and the heartbeat comm-health record
+    "parse_fault_spec": ("torchft_tpu.communicator", "parse_fault_spec"),
+    "CommHealth": ("torchft_tpu.wire", "CommHealth"),
+    "gray_failure_drill": ("torchft_tpu.drill", "gray_failure_drill"),
 }
 
 __all__ = list(_LAZY)

@@ -51,6 +51,17 @@ class Failure(enum.Enum):
     # shm hub and the cross-host ring loses a member mid-collective; the
     # next quorum must re-elect a leader (lowest surviving rank) and
     # /dev/shm must hold no orphaned segments (unlinked-after-map)
+    # -- gray failures (arxiv 2508.21613: policy should match failure TYPE;
+    # these are TRANSIENT, survived in-epoch, not crash-recovered) --------
+    NET_FLAKY = "netflaky"  # flaky link: frame loss + occasional resets;
+    # the lane retry/failover machinery must recover IN-epoch (zero quorum
+    # reconfigurations), visible as comm_lane_reconnects > 0
+    SLOW_NIC = "slownic"  # one persistently slow NIC: heavy stall windows
+    # drag every collective; detection (heartbeat comm-health) must flag
+    # the victim and, under TORCHFT_EVICT_SLOW, shed it from the quorum
+    PARTITION = "partition"  # the victim is cut from the fleet (data-plane
+    # partition mask + paused heartbeats): the majority side must form a
+    # quorum without it (anti split-brain keeps the minority down)
 
 
 @dataclass
@@ -59,6 +70,15 @@ class ChaosEvent:
     failure: Failure
     victim: Optional[str]
     detail: Dict[str, Any] = field(default_factory=dict)
+
+
+# default fault programs for the gray failure classes — shared by BOTH
+# replica planes so a tuned default cannot silently diverge between them
+_GRAY_DEFAULT_SPECS = {
+    Failure.NET_FLAKY: "loss:0.01,reset:0.002",
+    Failure.SLOW_NIC: "stall:0.5:50",
+    Failure.PARTITION: "partition:self",
+}
 
 
 def arm_heal_source_kill(
@@ -150,10 +170,19 @@ class ThreadReplica(ReplicaHandle):
         self._obj = obj
 
     def supports(self, failure: Failure) -> bool:
+        # liveness probe: a harness that exposes an ``alive`` attribute (a
+        # bool or a callable) lets the soak loop's every-victim-dead clean
+        # stop actually fire for flag-armed classes too
+        alive = getattr(self._obj, "alive", None)
+        if alive is not None and not (alive() if callable(alive) else alive):
+            return False
         if failure is Failure.HEAL_SOURCE:
             return getattr(self._obj, "heal_transport", None) is not None
         if failure is Failure.HOST_LEADER:
             return self._is_host_leader()
+        if failure in _GRAY_DEFAULT_SPECS:
+            comm = getattr(self._obj, "comm", None)
+            return callable(getattr(comm, "arm_faults", None))
         return failure in (Failure.KILL, Failure.DEADLOCK, Failure.COMM_ABORT)
 
     def _is_host_leader(self) -> bool:
@@ -196,6 +225,25 @@ class ThreadReplica(ReplicaHandle):
                 after_bytes=int(kw.get("after_bytes", 1 << 20)),
                 arm=kw.get("arm"),
             )
+        elif failure in _GRAY_DEFAULT_SPECS:
+            comm = getattr(self._obj, "comm", None)
+            if not callable(getattr(comm, "arm_faults", None)):
+                raise RuntimeError(
+                    f"{self.name}: no fault-armable communicator"
+                )
+            # spec=None DISARMS — chaos can heal a gray link mid-run
+            spec = kw.get("spec", _GRAY_DEFAULT_SPECS[failure])
+            comm.arm_faults(spec)
+            if failure is Failure.PARTITION:
+                # a partitioned replica loses its control plane too: sever
+                # the manager's lighthouse path (heartbeats AND quorum
+                # forwarding — a quorum rpc is an implicit heartbeat, so
+                # pausing only beats would keep the victim looking alive)
+                server = getattr(
+                    getattr(self._obj, "manager", None), "_manager_server", None
+                )
+                if server is not None:
+                    server.heartbeat_paused = spec is not None
         else:
             raise ValueError(f"thread plane cannot inject {failure}")
 
@@ -228,6 +276,10 @@ class ProcessReplica(ReplicaHandle):
         self._progress_fn = progress_fn
 
     def supports(self, failure: Failure) -> bool:
+        if failure in _GRAY_DEFAULT_SPECS:
+            # gray failures arm via TORCHFT_NET_FAULTS in the group's spawn
+            # env: supported when the supervisor exposes its specs
+            return hasattr(self._supervisor, "_specs")
         return failure in (
             Failure.KILL,
             Failure.SEGFAULT,
@@ -237,6 +289,33 @@ class ProcessReplica(ReplicaHandle):
         )
 
     def inject(self, failure: Failure, **kw: Any) -> None:
+        if failure in _GRAY_DEFAULT_SPECS:
+            # process plane: the fault program rides the group's spawn env
+            # (TORCHFT_NET_FAULTS) and lands on the next (re)start; pass
+            # restart=True to bounce the process so it comes up flaky now.
+            spec = kw.get("spec", _GRAY_DEFAULT_SPECS[failure])
+            spec_env = next(
+                (
+                    s.env
+                    for s in self._supervisor._specs
+                    if s.replica_group_id == self._gid
+                ),
+                None,
+            )
+            if spec_env is None:
+                raise RuntimeError(f"{self.name}: no spec for group {self._gid}")
+            if spec is None:
+                spec_env.pop("TORCHFT_NET_FAULTS", None)
+            else:
+                spec_env["TORCHFT_NET_FAULTS"] = str(spec)
+            if kw.get("restart", True):
+                ok = self._supervisor.kill(self._gid, sig=signal.SIGKILL)
+                if not ok:
+                    raise RuntimeError(
+                        f"{self.name}: no live process to restart with "
+                        f"{failure.value}"
+                    )
+            return
         if failure in (Failure.KILL, Failure.HEAL_SOURCE, Failure.HOST_LEADER):
             # process plane: a heal-source or host-leader kill IS a hard
             # kill — the caller picks a victim it knows holds the role (the
@@ -366,13 +445,32 @@ class ChaosController:
         stop: threading.Event,
         on_inject: Optional[Callable[[ChaosEvent], None]] = None,
         deadlock_secs: Optional[Callable[[], float]] = None,
+        rng: Optional[random.Random] = None,
     ) -> Dict[Failure, int]:
         """Inject failures on a Poisson schedule until ``stop`` — the soak
-        loop (``scripts/soak.py``).  Returns per-class injection counts."""
+        loop (``scripts/soak.py``).  Returns per-class injection counts.
+
+        ``rng`` (e.g. ``random.Random(seed)``) makes the whole soak
+        reproducible: it drives the inter-arrival draws, the class/victim
+        choice and the deadlock durations.  The loop stops cleanly — not
+        raising — when every victim is already dead (no replica supports
+        any of the requested classes)."""
+        if rng is not None:
+            self._rng = rng
         counts = {c: 0 for c in classes}
         while not stop.is_set():
             stop.wait(self._rng.expovariate(1.0 / mtbf_s))
             if stop.is_set():
+                break
+            if not any(
+                r.supports(c) for r in self.replicas for c in classes
+            ) and not (
+                Failure.LIGHTHOUSE in classes
+                and self._lighthouse_restart is not None
+            ):
+                logger.info(
+                    "chaos: every victim is dead; ending the soak cleanly"
+                )
                 break
             cls = self._rng.choice(list(classes))
             kw: Dict[str, Any] = {}

@@ -376,6 +376,143 @@ class _NetEmu:
                 bucket.consume(n)
 
 
+# ---------------------------------------------------------------------------
+# fault injection (gray failures)
+# ---------------------------------------------------------------------------
+
+# Per-link fault program for the TCP tier's data plane — the gray-failure
+# analog of the _NetEmu pacer: where the pacer shapes HEALTHY links, the
+# fault program makes them flaky.  Spec syntax (comma-separated terms):
+#
+#   loss:P            per-sub-frame drop probability; a dropped sub-frame is
+#                     retransmitted after one RTO (sender stalls ~2xRTT) —
+#                     the TCP-over-lossy-link throughput penalty, without
+#                     breaking the reliable-stream contract
+#   reset:P           per-sub-frame probability the lane's connection is
+#                     reset (socket closed mid-collective) — what the
+#                     in-epoch lane retry/failover machinery recovers from
+#   reset_once:N      deterministic form: exactly ONE reset after N
+#                     sub-frames have been sent (tests/drills)
+#   stall:P:MS        per-sub-frame probability the lane stalls MS
+#                     milliseconds (one slow-NIC hiccup)
+#   partition:A+B|self  partition mask: frames between the listed ranks and
+#                     everyone else are silently blackholed (both
+#                     directions); 'self' resolves to this mesh's own rank
+#
+# Armed via env (TORCHFT_NET_FAULTS=loss:0.01,reset:0.002) or at runtime —
+# TCPCommunicator.arm_faults() — so chaos can flip a healthy link
+# mid-collective.  TORCHFT_NET_FAULT_SEED makes draws reproducible.
+NET_FAULTS_ENV = "TORCHFT_NET_FAULTS"
+NET_FAULT_SEED_ENV = "TORCHFT_NET_FAULT_SEED"
+# In-epoch lane recovery: how many re-dial attempts a transiently-reset
+# lane gets before its traffic fails over to the surviving lanes, and the
+# base of the jittered exponential backoff between attempts.
+LANE_RETRIES_ENV = "TORCHFT_LANE_RETRIES"
+LANE_BACKOFF_MS_ENV = "TORCHFT_LANE_BACKOFF_MS"
+_LANE_RETRIES_DEFAULT = 2
+_LANE_BACKOFF_MS_DEFAULT = 50.0
+
+
+class _FaultProgram:
+    """Parsed TORCHFT_NET_FAULTS spec (immutable; per-mesh RNG state lives
+    on the mesh so one program can arm many meshes)."""
+
+    __slots__ = (
+        "loss", "reset", "reset_once", "stall_p", "stall_ms", "partition",
+    )
+
+    def __init__(
+        self,
+        loss: float = 0.0,
+        reset: float = 0.0,
+        reset_once: int = -1,
+        stall_p: float = 0.0,
+        stall_ms: float = 200.0,
+        partition: Optional[frozenset] = None,
+    ) -> None:
+        self.loss = loss
+        self.reset = reset
+        self.reset_once = reset_once
+        self.stall_p = stall_p
+        self.stall_ms = stall_ms
+        self.partition = partition
+
+    def active(self) -> bool:
+        return bool(
+            self.loss > 0
+            or self.reset > 0
+            or self.reset_once >= 0
+            or self.stall_p > 0
+            or self.partition
+        )
+
+    def partitions(self, my_rank: int, peer: int) -> bool:
+        """True when the (my_rank, peer) link crosses the partition mask."""
+        if not self.partition:
+            return False
+        mask = {my_rank if m == "self" else m for m in self.partition}
+        return (my_rank in mask) != (peer in mask)
+
+
+def parse_fault_spec(raw: Optional[str]) -> Optional[_FaultProgram]:
+    """Parse a fault-program spec string; None/empty disables injection."""
+    if not raw or not raw.strip():
+        return None
+    kw: Dict[str, object] = {}
+    for term in raw.strip().split(","):
+        parts = term.strip().split(":")
+        name = parts[0].strip().lower()
+        try:
+            if name == "loss":
+                kw["loss"] = float(parts[1])
+            elif name == "reset":
+                kw["reset"] = float(parts[1])
+            elif name == "reset_once":
+                kw["reset_once"] = int(parts[1])
+            elif name == "stall":
+                kw["stall_p"] = float(parts[1])
+                if len(parts) > 2:
+                    kw["stall_ms"] = float(parts[2])
+            elif name == "partition":
+                kw["partition"] = frozenset(
+                    "self" if m.strip().lower() == "self" else int(m)
+                    for m in parts[1].split("+")
+                )
+            else:
+                raise ValueError(f"unknown fault {name!r}")
+        except (IndexError, ValueError) as e:
+            # loud, not silent: a typo'd program would otherwise run CLEAN
+            # and record healthy numbers as a fault drill
+            raise CommunicatorError(
+                f"unparseable {NET_FAULTS_ENV} term {term!r}: {e} "
+                "(valid: loss:P, reset:P, reset_once:N, stall:P:MS, "
+                "partition:A+B|self)"
+            ) from e
+    return _FaultProgram(**kw)  # type: ignore[arg-type]
+
+
+def _net_faults_from_env() -> Optional[_FaultProgram]:
+    return parse_fault_spec(os.environ.get(NET_FAULTS_ENV))
+
+
+def _lane_retry_knobs() -> Tuple[int, float]:
+    """(re-dial attempts, backoff base seconds) for in-epoch lane recovery."""
+    try:
+        retries = int(
+            os.environ.get(LANE_RETRIES_ENV, "") or _LANE_RETRIES_DEFAULT
+        )
+        backoff_ms = float(
+            os.environ.get(LANE_BACKOFF_MS_ENV, "") or _LANE_BACKOFF_MS_DEFAULT
+        )
+    except ValueError as e:
+        raise CommunicatorError(
+            f"unparseable {LANE_RETRIES_ENV}="
+            f"{os.environ.get(LANE_RETRIES_ENV)!r} / {LANE_BACKOFF_MS_ENV}="
+            f"{os.environ.get(LANE_BACKOFF_MS_ENV)!r}"
+        ) from e
+    return max(0, retries), max(0.001, backoff_ms / 1000.0)
+
+
 # named emulation profiles (TORCHFT_NET_EMU): (link Gbit/s, RTT ms).  The
 # aliases with the explicit RTT suffix match benchmarks/dcn_bench.py's
 # profile names, so a bench row can be reproduced verbatim from env.
@@ -450,6 +587,19 @@ _STRIPE_ALIGN = 64
 # extended hello's tail as a frame header.  (Ranks are tiny integers; the
 # top bit is never a real rank.)
 _LANE_HELLO_FLAG = 1 << 63
+# Second-highest bit marks a RECONNECT hello: a lane re-dialed mid-epoch
+# after a transient reset (in-epoch lane recovery).  Always the extended
+# 32-byte form; only this build speaks it, which is fine — a peer that
+# cannot reconnect simply leaves the lane dead and the legacy poison path
+# applies.
+_LANE_RECONN_FLAG = 1 << 62
+# Reserved frame tag for in-band lane-failover control frames (a dead
+# lane's endpoints agree on outstanding sub-frames over a surviving lane).
+# Data tags are small positive ints (tag bases + step indices); the top of
+# the u64 space is never a real tag.
+_LANE_CTRL_TAG = (1 << 64) - 17
+_LANE_CTRL = struct.Struct("<QQQ")  # kind, dead lane, completed-rx count
+_LANE_RESYNC = struct.Struct("<QQ")  # tx seq, rx seq (reconnect handshake)
 
 
 def _ring_lanes(emu: Optional[_NetEmu]) -> int:
@@ -730,6 +880,48 @@ class _ShmSeg:
         return memoryview(self._mm)[start : start + nbytes]
 
 
+def _rearm_frame(frame: dict) -> None:
+    """(Re)build a send frame's live buffer list from its retained
+    originals — fresh frames and reset-replayed frames go through the same
+    path, so a replay is byte-identical to the first transmission."""
+    bufs = [memoryview(frame["hdr"])]
+    payload = frame["payload"]
+    if payload is not None and len(payload):
+        bufs.append(payload)
+    frame["bufs"] = bufs
+
+
+def _mk_frame(hdr: bytes, payload: Optional[memoryview], ctrl: bool = False) -> dict:
+    frame = {"hdr": hdr, "payload": payload, "ctrl": ctrl, "checked": ctrl}
+    _rearm_frame(frame)
+    return frame
+
+
+class _ExchangeCtx:
+    """Mutable state of one ``exchange()`` call, shared with the lane
+    recovery machinery: the send/recv FIFOs, per-socket receive state, the
+    completed-sub-frame log (replay source for lane resets), pacer gates,
+    and in-flight failover handshakes."""
+
+    __slots__ = (
+        "send_q", "recv_q", "recv_st", "sent_log", "frame_gates",
+        "pending_failover", "dying", "dying_sends",
+    )
+
+    def __init__(self) -> None:
+        self.send_q: Dict[Tuple[int, int], List[dict]] = {}
+        self.recv_q: Dict[Tuple[int, int], List[dict]] = {}
+        self.recv_st: Dict[Tuple[int, int], dict] = {}
+        self.sent_log: Dict[Tuple[int, int], List[dict]] = {}
+        self.frame_gates: Dict[Tuple[int, int], float] = {}
+        self.pending_failover: Dict[Tuple[int, int], dict] = {}
+        # injected-reset half-close state: lanes we SHUT_WR'd and are
+        # draining to EOF before recovery (so no flushed byte is ever
+        # destroyed by an abortive close), with their parked sends
+        self.dying: set = set()
+        self.dying_sends: Dict[Tuple[int, int], List[dict]] = {}
+
+
 class _TcpMesh:
     """Full mesh of rank-to-rank lane sockets for one quorum epoch.
 
@@ -758,6 +950,7 @@ class _TcpMesh:
         lanes: int = 0,
         host_id: Optional[str] = None,
         hier: Optional[str] = None,
+        faults: Optional[_FaultProgram] = None,
     ) -> None:
         self.rank = rank
         self.world_size = world_size
@@ -778,6 +971,33 @@ class _TcpMesh:
         self.lane_tx_bytes = [0] * self.lanes
         self.lane_rx_bytes = [0] * self.lanes
         self.lane_stalls = [0] * self.lanes
+        # gray-failure machinery: fault program (env or runtime-armed),
+        # in-epoch lane recovery knobs + counters, per-(peer, lane)
+        # completed-sub-frame sequence counters the reconnect/failover
+        # resync handshakes run on, and the per-peer dead-lane set (agreed
+        # by handshake, so both sides route identically)
+        self.faults: Optional[_FaultProgram] = (
+            faults if faults is not None else _net_faults_from_env()
+        )
+        import random as _random
+
+        seed_raw = os.environ.get(NET_FAULT_SEED_ENV, "")
+        self._fault_rng = _random.Random(
+            (int(seed_raw) * 1_000_003 + rank) if seed_raw else None
+        )
+        self.lane_retries, self.lane_backoff_s = _lane_retry_knobs()
+        self.lane_reconnects = 0
+        self.lane_failovers = 0
+        self.faults_injected = 0
+        self._fault_frames = 0
+        self._reset_once_fired = False
+        self._tx_seq: Dict[Tuple[int, int], int] = {}
+        self._rx_seq: Dict[Tuple[int, int], int] = {}
+        self.dead_lanes: Dict[int, set] = {}
+        # lane re-dials land here (accept thread -> recovering op thread)
+        self._pending_reconn: Dict[Tuple[int, int], socket.socket] = {}
+        self._reconn_cv = threading.Condition()
+        self._peer_addrs: Dict[int, Tuple[str, int]] = {}
         # topology (hierarchical collectives): filled by _topo_rendezvous
         # below; None = flat ring (the byte-for-byte legacy data plane)
         self.topo: Optional[_HostTopology] = None
@@ -868,6 +1088,9 @@ class _TcpMesh:
             for peer in range(rank):
                 addr = store.get(f"{peer}", timeout=timeout_s).decode()
                 peer_host, peer_port = addr.rsplit(":", 1)
+                # kept for in-epoch lane re-dials (we are the dialer for
+                # every peer with a lower rank)
+                self._peer_addrs[peer] = (peer_host.strip("[]"), int(peer_port))
                 for lane in range(self.lanes):
                     sock = socket.create_connection(
                         (peer_host.strip("[]"), int(peer_port)),
@@ -896,8 +1119,19 @@ class _TcpMesh:
             if acceptor.is_alive():
                 raise CommunicatorError(f"rank {rank} rendezvous timed out")
             self.lane_socks.update(inbound)
-        finally:
+        except BaseException:
             listener.close()
+            raise
+        # the listener stays open for the epoch: a transiently-reset lane
+        # re-dials it mid-epoch (in-epoch lane recovery) instead of forcing
+        # a full re-rendezvous; abort() closes it
+        self._listener = listener
+        self._timeout_s = timeout_s
+        threading.Thread(
+            target=self._reconn_accept,
+            name=f"tpuft_lane_reconn_{rank}",
+            daemon=True,
+        ).start()
 
         for (peer, lane), sock in self.lane_socks.items():
             sock.setblocking(False)
@@ -1113,10 +1347,87 @@ class _TcpMesh:
     def lane_sock(self, peer: int, lane: int) -> socket.socket:
         return self.lane_socks[(peer, lane)]
 
+    def _alive_lanes(self, peer: int) -> List[int]:
+        dead = self.dead_lanes.get(peer, ())
+        return [ln for ln in range(self.lanes) if ln not in dead]
+
+    def _lane_route(self, peer: int, lane: int) -> int:
+        """Transport lane actually carrying logical lane ``lane`` to
+        ``peer``: identity while the lane lives; after an agreed failover,
+        the lowest surviving lane.  Both endpoints derive the dead set from
+        the same failover handshake, so routed frames stay matched — the
+        LOGICAL ``_lane_parts`` split (and therefore the reduction math)
+        never changes, only the transport assignment."""
+        dead = self.dead_lanes.get(peer)
+        if not dead or lane not in dead:
+            return lane
+        alive = self._alive_lanes(peer)
+        if not alive:
+            raise PeerGoneError(f"all lanes to rank {peer} are dead")
+        return alive[0]
+
     def p2p_sock(self, peer: int) -> socket.socket:
         """The designated point-to-point lane socket (last lane; the one and
-        only socket at lanes == 1)."""
-        return self.lane_socks[(peer, self.p2p_lane)]
+        only socket at lanes == 1).  Routed around failed-over lanes."""
+        return self.lane_socks[(peer, self._lane_route(peer, self.p2p_lane))]
+
+    # -- in-epoch lane recovery ----------------------------------------------
+
+    def _reconn_accept(self) -> None:
+        """Accept in-epoch lane re-dials for the life of the mesh.
+
+        A reconnect hello is always the 32-byte extended form with
+        ``_LANE_RECONN_FLAG`` set; anything else is dropped (stray dials).
+        The accepted socket is parked in ``_pending_reconn`` for the
+        recovering op thread to pick up — the resync handshake runs there,
+        never here, so this loop can stay dumb and lock-free."""
+        try:
+            self._listener.settimeout(0.25)
+        except OSError:
+            return
+        while not self._aborted.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                conn.settimeout(5.0)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                raw = _recv_exact(conn, 8, self._aborted, 5.0)
+                (first,) = struct.unpack("<Q", raw)
+                if not first & _LANE_RECONN_FLAG:
+                    conn.close()
+                    continue
+                peer_rank = int(
+                    first & ~(_LANE_HELLO_FLAG | _LANE_RECONN_FLAG)
+                )
+                tail = _recv_exact(conn, 24, self._aborted, 5.0)
+                lane, peer_lanes, peer_floor = struct.unpack("<QQQ", tail)
+                if (
+                    not 0 <= peer_rank < self.world_size
+                    or int(peer_lanes) != self.lanes
+                    or int(peer_floor) != self.stripe_floor
+                    or not 0 <= int(lane) < self.lanes
+                ):
+                    conn.close()
+                    continue
+            except (OSError, CommunicatorError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            with self._reconn_cv:
+                stale = self._pending_reconn.pop((peer_rank, int(lane)), None)
+                if stale is not None:
+                    try:
+                        stale.close()
+                    except OSError:
+                        pass
+                self._pending_reconn[(peer_rank, int(lane))] = conn
+                self._reconn_cv.notify_all()
 
     # -- low-level duplex IO -------------------------------------------------
 
@@ -1127,6 +1438,20 @@ class _TcpMesh:
             # blocked in an shm spin (possibly in OTHER processes) unblock
             # with CommunicatorAborted, same poison path as the sockets
             self.shm.set_abort()
+        listener = getattr(self, "_listener", None)
+        if listener is not None:
+            try:
+                listener.close()
+            except OSError:
+                pass
+        with self._reconn_cv:
+            pending, self._pending_reconn = dict(self._pending_reconn), {}
+            self._reconn_cv.notify_all()
+        for sock in pending.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
         for sock in self.lane_socks.values():
             try:
                 sock.close()
@@ -1246,36 +1571,47 @@ class _TcpMesh:
         multiplexing all lanes) is what makes ring steps deadlock-free:
         every rank sends to its right neighbor while receiving from its
         left without ordering constraints.
+
+        Gray-failure resilience (striped path only, ``lane=None``): a
+        transient connection reset on one lane re-dials with bounded
+        jittered backoff (``TORCHFT_LANE_RETRIES`` /
+        ``TORCHFT_LANE_BACKOFF_MS``) and replays the sub-frames the reset
+        swallowed (every completed sub-frame of the CURRENT exchange is
+        retained for replay; resets reaching deeper poison the epoch as
+        before).  If re-dial fails, the two endpoints agree — via a control
+        frame on a surviving lane — on the dead lane's outstanding
+        sub-frames and re-route them; the epoch only poisons when every
+        lane to a peer is dead.  Point-to-point ops (explicit ``lane``)
+        keep the peer-scoped fail-stop contract the striped heal relies on.
         """
         emu = self._emu
+        recovery_ok = lane is None
 
         def _parts(nbytes: int) -> List[Tuple[int, int, int]]:
             if lane is not None:
                 return [(lane, 0, nbytes)]
             return _lane_parts(nbytes, self.lanes, self.stripe_floor)
 
-        # per-socket FIFO of outgoing sub-frames; each frame is a list of
-        # pending buffers (header, then payload) so a socket carries its
-        # sub-frames strictly in order
-        send_q: Dict[Tuple[int, int], List[List[memoryview]]] = {}
+        # per-socket FIFO of outgoing sub-frames; each frame keeps its
+        # original (header, payload) so a lane reset can replay it whole,
+        # plus the live buffer list carrying sub-frames strictly in order
+        ctx = _ExchangeCtx()
+        send_q, recv_q = ctx.send_q, ctx.recv_q
         for peer, tag, view in sends:
             for ln, start, stop in _parts(len(view)):
                 header = _HDR.pack(stop - start, tag)
-                frame = [memoryview(header)]
-                if stop > start:
-                    frame.append(view[start:stop])
-                send_q.setdefault((peer, ln), []).append(frame)
-        # per-socket FIFO of expected sub-frames
-        recv_q: Dict[Tuple[int, int], List[dict]] = {}
+                key = (peer, self._lane_route(peer, ln))
+                send_q.setdefault(key, []).append(
+                    _mk_frame(header, view[start:stop] if stop > start else None)
+                )
         for entry in recvs:
             peer, tag, view = entry[0], entry[1], entry[2]
             on_part = entry[3] if len(entry) > 3 else None
             for ln, start, stop in _parts(len(view)):
-                recv_q.setdefault((peer, ln), []).append(
+                key = (peer, self._lane_route(peer, ln))
+                recv_q.setdefault(key, []).append(
                     {
-                        "hdr": bytearray(),
                         "view": view[start:stop],
-                        "off": 0,
                         "tag": tag,
                         "start": start,
                         "stop": stop,
@@ -1283,47 +1619,113 @@ class _TcpMesh:
                     }
                 )
 
-        frame_gates: Dict[Tuple[int, int], float] = {}
+        frame_gates = ctx.frame_gates
         if emu is not None:
             for key in send_q:
                 # half-RTT before the first frame's first byte leaves; the
                 # gate re-arms as each subsequent frame reaches the head
                 frame_gates[key] = emu.frame_gate()
 
-        while send_q or recv_q:
+        partition_noted: set = set()
+
+        def _blocked(key: Tuple[int, int]) -> bool:
+            prog = self.faults
+            if prog is None or not prog.partitions(self.rank, key[0]):
+                return False
+            if key[0] not in partition_noted:
+                partition_noted.add(key[0])
+                self.faults_injected += 1
+                logger.warning(
+                    "fault injection: partition mask blackholes rank %d <-> %d",
+                    self.rank,
+                    key[0],
+                )
+            return True
+
+        while send_q or recv_q or ctx.pending_failover or ctx.dying:
             self._check_abort()
             if time.monotonic() > deadline:
                 raise TimeoutError("collective exchange timed out")
-            rlist = [self.lane_socks[k] for k in recv_q]
-            wlist = [self.lane_socks[k] for k in send_q]
+            failover_peers = {k[0] for k in ctx.pending_failover}
+            rlist = [
+                self.lane_socks[k]
+                for k in self.lane_socks
+                if not _blocked(k)
+                and (
+                    k in recv_q
+                    or k in ctx.dying
+                    or k[0] in failover_peers
+                    or (k in ctx.recv_st and ctx.recv_st[k]["hdr"])
+                )
+            ]
+            wlist = [
+                self.lane_socks[k]
+                for k in send_q
+                if k in self.lane_socks
+                and not _blocked(k)
+                and k not in ctx.dying
+            ]
+            if not rlist and not wlist:
+                # everything outstanding is blackholed (partition mask) or
+                # parked on a failover handshake: wait out the deadline
+                time.sleep(0.01)
+                continue
             readable, writable, _ = select.select(rlist, wlist, [], 0.1)
 
             paced_block = False
+            faulted: List[Tuple[Tuple[int, int], BaseException]] = []
             for sock in writable:
-                key = self._sock_key[sock]
+                key = self._sock_key.get(sock)
+                if key is None:
+                    continue
                 frames = send_q.get(key)
                 if frames is None:
                     continue
                 ln = key[1]
-                if emu is not None and time.monotonic() < frame_gates.get(
-                    key, 0.0
-                ):
+                if time.monotonic() < frame_gates.get(key, 0.0):
                     paced_block = True
                     self.lane_stalls[ln] += 1
                     continue
                 try:
                     while frames:
-                        bufs = frames[0]
+                        frame = frames[0]
+                        bufs = frame["bufs"]
                         # len 0 = a zero-payload frame's body (e.g. the
                         # empty ring chunk at ws=2): nothing to pace
                         while bufs and len(bufs[0]) == 0:
                             bufs.pop(0)
                         if not bufs:
                             frames.pop(0)
+                            if not frame["ctrl"]:
+                                ctx.sent_log.setdefault(key, []).append(frame)
+                                self._tx_seq[key] = (
+                                    self._tx_seq.get(key, 0) + 1
+                                )
                             if frames and emu is not None:
                                 frame_gates[key] = emu.frame_gate()
                                 break
                             continue
+                        verdict = self._fault_gate(key, frame, frame_gates)
+                        if verdict == "reset":
+                            # half-close choreography: FIN our send side,
+                            # park the unsent frames, and keep DRAINING
+                            # until the peer's EOF comes back — an abortive
+                            # close would destroy flushed-but-unread bytes
+                            # and push the loss beyond the replay log
+                            try:
+                                sock.shutdown(socket.SHUT_WR)
+                            except OSError:
+                                pass
+                            ctx.dying.add(key)
+                            ctx.dying_sends[key] = send_q.pop(key, [])
+                            logger.warning(
+                                "fault injection: reset lane %s", key
+                            )
+                            break
+                        if verdict == "stall":
+                            paced_block = True
+                            self.lane_stalls[ln] += 1
+                            break
                         chunk = bufs[0]
                         if emu is not None:
                             allowed = emu.allow(len(chunk), stream=key)
@@ -1343,25 +1745,45 @@ class _TcpMesh:
                             break
                 except BlockingIOError:
                     self.lane_stalls[ln] += 1
+                except PeerGoneError as e:
+                    faulted.append((key, e))
+                    continue
                 except OSError as e:
-                    raise PeerGoneError(
-                        f"send to rank {key[0]} failed: {e}"
-                    ) from e
-                if frames is not None and not any(frames):
-                    del send_q[key]
+                    faulted.append(
+                        (key, PeerGoneError(f"send to rank {key[0]} failed: {e}"))
+                    )
+                    continue
+                if frames is not None and not frames:
+                    send_q.pop(key, None)
 
             for sock in readable:
-                key = self._sock_key[sock]
-                queue_ = recv_q.get(key)
-                if not queue_:
+                key = self._sock_key.get(sock)
+                if key is None:
+                    continue
+                if any(k == key for k, _ in faulted):
                     continue
                 peer, ln = key
                 # drain the socket fully per readiness event (sub-frames
                 # arrive back to back): one recv per select round would
                 # multiply the syscall count and cap the aggregate rate
                 try:
-                    while queue_:
-                        st = queue_[0]
+                    while True:
+                        # stop at the exchange's expectation boundary: with
+                        # nothing expected and no frame mid-flight, reading
+                        # on would eat the NEXT exchange's bytes (only a
+                        # pending failover justifies listening for a
+                        # peer's control frame beyond that)
+                        if (
+                            key not in ctx.recv_st
+                            and not recv_q.get(key)
+                            and key not in ctx.dying
+                            and key[0]
+                            not in {k[0] for k in ctx.pending_failover}
+                        ):
+                            break
+                        st = ctx.recv_st.setdefault(
+                            key, {"hdr": bytearray(), "off": 0, "exp": None}
+                        )
                         if len(st["hdr"]) < _HDR.size:
                             chunk = sock.recv(_HDR.size - len(st["hdr"]))
                             if not chunk:
@@ -1371,43 +1793,449 @@ class _TcpMesh:
                             st["hdr"] += chunk
                             if len(st["hdr"]) == _HDR.size:
                                 nbytes, tag = _HDR.unpack(bytes(st["hdr"]))
-                                if tag != st["tag"]:
-                                    raise CommunicatorError(
-                                        f"tag mismatch from rank {peer}: "
-                                        f"got {tag}, want {st['tag']}"
-                                    )
-                                if nbytes != len(st["view"]):
-                                    raise CommunicatorError(
-                                        f"size mismatch from rank {peer}: "
-                                        f"got {nbytes}, want "
-                                        f"{len(st['view'])} (lane {ln})"
-                                    )
-                        elif st["off"] < len(st["view"]):
-                            n = sock.recv_into(st["view"][st["off"] :])
+                                if tag == _LANE_CTRL_TAG:
+                                    if nbytes != _LANE_CTRL.size:
+                                        raise CommunicatorError(
+                                            f"bad lane ctrl frame from rank "
+                                            f"{peer}: {nbytes} bytes"
+                                        )
+                                    st["exp"] = {
+                                        "view": memoryview(
+                                            bytearray(_LANE_CTRL.size)
+                                        ),
+                                        "ctrl": True,
+                                    }
+                                else:
+                                    queue_ = recv_q.get(key)
+                                    if not queue_:
+                                        raise CommunicatorError(
+                                            f"unexpected frame tag {tag} "
+                                            f"from rank {peer} (lane {ln})"
+                                        )
+                                    exp = queue_[0]
+                                    if tag != exp["tag"]:
+                                        raise CommunicatorError(
+                                            f"tag mismatch from rank {peer}: "
+                                            f"got {tag}, want {exp['tag']}"
+                                        )
+                                    if nbytes != len(exp["view"]):
+                                        raise CommunicatorError(
+                                            f"size mismatch from rank {peer}: "
+                                            f"got {nbytes}, want "
+                                            f"{len(exp['view'])} (lane {ln})"
+                                        )
+                                    st["exp"] = exp
+                        elif st["off"] < len(st["exp"]["view"]):
+                            n = sock.recv_into(st["exp"]["view"][st["off"] :])
                             if n == 0:
                                 raise PeerGoneError(
                                     f"connection to rank {peer} closed"
                                 )
                             st["off"] += n
-                            self.lane_rx_bytes[ln] += n
+                            if not st["exp"].get("ctrl"):
+                                self.lane_rx_bytes[ln] += n
                         # complete once the header arrived and the payload
                         # (possibly zero-length) is fully received
                         if (
                             len(st["hdr"]) == _HDR.size
-                            and st["off"] == len(st["view"])
+                            and st["off"] == len(st["exp"]["view"])
                         ):
-                            queue_.pop(0)
-                            if st["on_part"] is not None:
-                                st["on_part"](st["start"], st["stop"])
+                            exp = st["exp"]
+                            ctx.recv_st.pop(key, None)
+                            if exp.get("ctrl"):
+                                _kind, dead_ln, peer_rx = _LANE_CTRL.unpack(
+                                    bytes(exp["view"])
+                                )
+                                self._handle_lane_ctrl(
+                                    peer, int(dead_ln), int(peer_rx), ctx
+                                )
+                            else:
+                                queue_ = recv_q[key]
+                                queue_.pop(0)
+                                if not queue_:
+                                    del recv_q[key]
+                                self._rx_seq[key] = (
+                                    self._rx_seq.get(key, 0) + 1
+                                )
+                                if exp["on_part"] is not None:
+                                    exp["on_part"](exp["start"], exp["stop"])
                 except BlockingIOError:
                     pass
-                if not queue_:
-                    del recv_q[key]
+                except (OSError, PeerGoneError) as e:
+                    faulted.append(
+                        (
+                            key,
+                            e
+                            if isinstance(e, PeerGoneError)
+                            else PeerGoneError(str(e)),
+                        )
+                    )
+
+            for key, exc in faulted:
+                if not recovery_ok:
+                    raise exc
+                self._lane_fault(key, exc, ctx, deadline)
 
             if paced_block:
                 # socket writable but the pacer denied bytes — select would
                 # return immediately and spin the op thread hot
                 time.sleep(0.0005)
+
+    # -- gray-failure recovery internals -------------------------------------
+
+    def _fault_gate(
+        self, key: Tuple[int, int], frame: dict, frame_gates: Dict
+    ) -> Optional[str]:
+        """Evaluate the armed fault program once per sub-frame, at the
+        moment the frame reaches the head of its lane queue (before its
+        first byte leaves).  Returns 'reset' (connection torn down),
+        'stall' (a loss-retransmit or slow-NIC window was injected as a
+        frame gate), or None (clean)."""
+        prog = self.faults
+        if prog is None or frame["checked"]:
+            return None
+        frame["checked"] = True
+        if not prog.active():
+            return None
+        if prog.reset_once >= 0 and not self._reset_once_fired:
+            self._fault_frames += 1
+            if self._fault_frames > prog.reset_once:
+                self._reset_once_fired = True
+                self.faults_injected += 1
+                return "reset"
+        if prog.reset > 0 and self._fault_rng.random() < prog.reset:
+            self.faults_injected += 1
+            return "reset"
+        if prog.loss > 0 and self._fault_rng.random() < prog.loss:
+            # a dropped sub-frame costs one retransmit timeout: the sender
+            # stalls ~2xRTT before the bytes go out — the TCP-on-lossy-link
+            # throughput penalty without breaking the reliable stream
+            rtt = self._emu.rtt_s if self._emu is not None else 0.0
+            self.faults_injected += 1
+            frame_gates[key] = time.monotonic() + max(2.0 * rtt, 0.02)
+            return "stall"
+        if prog.stall_p > 0 and self._fault_rng.random() < prog.stall_p:
+            self.faults_injected += 1
+            frame_gates[key] = time.monotonic() + prog.stall_ms / 1000.0
+            return "stall"
+        return None
+
+    def _lane_fault(
+        self,
+        key: Tuple[int, int],
+        exc: BaseException,
+        ctx: _ExchangeCtx,
+        deadline: float,
+    ) -> None:
+        """One lane to a live peer died mid-exchange: re-dial it with
+        bounded jittered backoff and replay what the reset swallowed; if
+        that fails, fail the lane over to a survivor.  Raises (poisoning
+        the epoch) only when no lane to the peer survives or the reset ate
+        sub-frames older than the current collective."""
+        if key in ctx.dying:
+            # we half-closed this lane ourselves (injected reset) and have
+            # now drained it to EOF: un-park the sends so recovery replays
+            # them like any other outstanding frames
+            ctx.dying.discard(key)
+            parked = ctx.dying_sends.pop(key, [])
+            if parked:
+                ctx.send_q[key] = parked + ctx.send_q.get(key, [])
+        old = self.lane_socks.get(key)
+        if old is not None:
+            self._sock_key.pop(old, None)
+            try:
+                old.close()
+            except OSError:
+                pass
+        # discard partial receive state: post-resync the peer re-sends the
+        # interrupted sub-frame whole
+        ctx.recv_st.pop(key, None)
+        logger.warning(
+            "lane %s: transient fault (%s); attempting in-epoch recovery",
+            key,
+            exc,
+        )
+        if self._try_reconnect(key, ctx, deadline):
+            self.lane_reconnects += 1
+            logger.info("lane %s: reconnected in-epoch", key)
+            return
+        self._initiate_failover(key, ctx, exc)
+
+    def _try_reconnect(
+        self, key: Tuple[int, int], ctx: _ExchangeCtx, deadline: float
+    ) -> bool:
+        """Bounded re-dial of one lane.  The endpoint that dialed the lane
+        at rendezvous (the higher rank) re-dials the peer's epoch listener;
+        the other side waits for the accept thread to park the replacement.
+        On success both run the resync handshake and replay."""
+        peer, ln = key
+        retries = self.lane_retries
+        if retries <= 0:
+            return False
+        if self.rank > peer:
+            addr = self._peer_addrs.get(peer)
+            if addr is None:
+                return False
+            for attempt in range(retries):
+                delay = (
+                    self.lane_backoff_s
+                    * (2 ** attempt)
+                    * (0.5 + self._fault_rng.random())
+                )
+                if self._aborted.wait(delay):
+                    raise CommunicatorAborted("communicator aborted")
+                if time.monotonic() > deadline:
+                    return False
+                sock: Optional[socket.socket] = None
+                try:
+                    sock = socket.create_connection(
+                        addr,
+                        timeout=min(
+                            5.0, max(0.1, deadline - time.monotonic())
+                        ),
+                    )
+                    sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                    sock.settimeout(5.0)
+                    sock.sendall(
+                        struct.pack(
+                            "<QQQQ",
+                            self.rank
+                            | _LANE_HELLO_FLAG
+                            | _LANE_RECONN_FLAG,
+                            ln,
+                            self.lanes,
+                            self.stripe_floor,
+                        )
+                    )
+                    sock.sendall(
+                        _LANE_RESYNC.pack(
+                            self._tx_seq.get(key, 0), self._rx_seq.get(key, 0)
+                        )
+                    )
+                    raw = _recv_exact(
+                        sock, _LANE_RESYNC.size, self._aborted, 5.0
+                    )
+                    _peer_tx, peer_rx = _LANE_RESYNC.unpack(raw)
+                except (OSError, CommunicatorError):
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                    continue
+                self._install_lane(key, sock, int(peer_rx), ctx)
+                return True
+            return False
+        # the peer re-dials us; its worst-case retry schedule bounds our
+        # wait (plus slack so a slow final attempt still lands)
+        window = self.lane_backoff_s * 1.5 * (2 ** retries) + 0.25
+        wait_deadline = min(deadline, time.monotonic() + window)
+        with self._reconn_cv:
+            while key not in self._pending_reconn:
+                if self._aborted.is_set():
+                    raise CommunicatorAborted("communicator aborted")
+                remaining = wait_deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._reconn_cv.wait(min(remaining, 0.1))
+            sock = self._pending_reconn.pop(key)
+        try:
+            sock.settimeout(5.0)
+            raw = _recv_exact(sock, _LANE_RESYNC.size, self._aborted, 5.0)
+            _peer_tx, peer_rx = _LANE_RESYNC.unpack(raw)
+            sock.sendall(
+                _LANE_RESYNC.pack(
+                    self._tx_seq.get(key, 0), self._rx_seq.get(key, 0)
+                )
+            )
+        except (OSError, CommunicatorError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return False
+        self._install_lane(key, sock, int(peer_rx), ctx)
+        return True
+
+    def _install_lane(
+        self,
+        key: Tuple[int, int],
+        sock: socket.socket,
+        peer_rx: int,
+        ctx: _ExchangeCtx,
+    ) -> None:
+        """Swap a re-dialed socket into the lane maps and replay the
+        sub-frames the reset swallowed (peer_rx = how many completed data
+        sub-frames the peer HAS; everything we counted beyond that is
+        re-sent whole, byte-identical, from the exchange's sent log)."""
+        peer, ln = key
+        missing = self._tx_seq.get(key, 0) - peer_rx
+        log = ctx.sent_log.get(key, [])
+        if missing < 0 or missing > len(log):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise CommunicatorError(
+                f"lane {key} reset lost {missing} sub-frames beyond the "
+                "current collective; cannot replay in-epoch"
+            )
+        q = ctx.send_q.setdefault(key, [])
+        if missing:
+            replay = log[-missing:]
+            del log[-missing:]
+            q[:0] = replay
+            self._tx_seq[key] = peer_rx
+        # re-arm every queued frame whole: the head may have been
+        # part-written when the lane died, and the peer discarded its
+        # partial receive state at resync
+        for frame in q:
+            _rearm_frame(frame)
+        if not q:
+            ctx.send_q.pop(key, None)
+        sock.setblocking(False)
+        self.lane_socks[key] = sock
+        self._sock_key[sock] = key
+        if ln == 0:
+            self.peers[peer] = sock
+        ctx.frame_gates.pop(key, None)
+
+    def _initiate_failover(
+        self, key: Tuple[int, int], ctx: _ExchangeCtx, exc: BaseException
+    ) -> None:
+        """Re-dial failed: park the dead lane's outstanding traffic and
+        tell the peer (a control frame on the lowest surviving lane, with
+        our completed-rx count) so both sides can agree on what to replay
+        where.  Raises PeerGoneError when no lane survives — the epoch
+        poisons only then."""
+        peer, ln = key
+        if key in ctx.dying:
+            ctx.dying.discard(key)
+            parked = ctx.dying_sends.pop(key, [])
+            if parked:
+                ctx.send_q[key] = parked + ctx.send_q.get(key, [])
+        self.lane_socks.pop(key, None)
+        alive = [
+            l
+            for l in self._alive_lanes(peer)
+            if l != ln
+            and (peer, l) in self.lane_socks
+            and (peer, l) not in ctx.pending_failover
+        ]
+        if not alive:
+            raise PeerGoneError(
+                f"rank {peer} unreachable on every lane: {exc}"
+            )
+        surv = alive[0]
+        ent = ctx.pending_failover.get(key)
+        if ent is None:
+            ent = ctx.pending_failover[key] = {
+                "surv": surv,
+                "peer_rx": None,
+                "sent_ctrl": False,
+                "sends": [],
+                "recvs": [],
+            }
+        ent["sends"].extend(ctx.send_q.pop(key, []))
+        ent["recvs"].extend(ctx.recv_q.pop(key, []))
+        ctx.recv_st.pop(key, None)
+        if not ent["sent_ctrl"]:
+            blob = _LANE_CTRL.pack(1, ln, self._rx_seq.get(key, 0))
+            raw = _HDR.pack(len(blob), _LANE_CTRL_TAG) + blob
+            ctx.send_q.setdefault((peer, surv), []).append(
+                _mk_frame(raw, None, ctrl=True)
+            )
+            ent["sent_ctrl"] = True
+            logger.warning(
+                "lane %s dead after retries (%s); failing over to lane %d",
+                key,
+                exc,
+                surv,
+            )
+        if ent["peer_rx"] is not None:
+            self._finalize_failover(key, ctx)
+
+    def _handle_lane_ctrl(
+        self, peer: int, dead_ln: int, peer_rx: int, ctx: _ExchangeCtx
+    ) -> None:
+        """The peer declared one of our shared lanes dead.  Adopt (close
+        our end, park, answer with our own declaration) if we had not
+        noticed, then finalize once both declarations are in hand."""
+        key = (peer, dead_ln)
+        if dead_ln in self.dead_lanes.get(peer, ()):
+            return  # duplicate declaration for an already-buried lane
+        ent = ctx.pending_failover.get(key)
+        if ent is None:
+            sock = self.lane_socks.get(key)
+            if sock is not None:
+                self._sock_key.pop(sock, None)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            try:
+                self._initiate_failover(
+                    key, ctx, CommunicatorError("peer declared lane dead")
+                )
+            except PeerGoneError as e:
+                # no survivor left: total peer loss, poison the epoch (the
+                # caller's recv loop must not mistake this for a
+                # recoverable fault on the lane that carried the ctrl)
+                raise CommunicatorError(str(e)) from e
+            ent = ctx.pending_failover[key]
+        ent["peer_rx"] = peer_rx
+        if ent["sent_ctrl"]:
+            self._finalize_failover(key, ctx)
+
+    def _finalize_failover(self, key: Tuple[int, int], ctx: _ExchangeCtx) -> None:
+        """Both endpoints agreed the lane is dead: replay the sub-frames
+        the peer is missing and re-route all parked traffic onto the
+        surviving lane.  The LOGICAL ``_lane_parts`` split is untouched —
+        only transport assignment changes — so results stay bit-identical."""
+        peer, ln = key
+        ent = ctx.pending_failover.pop(key)
+        surv_key = (peer, ent["surv"])
+        if surv_key not in self.lane_socks:
+            # the survivor chosen at initiate died while the handshake was
+            # in flight (a second transient fault in one exchange): poison
+            # NOW rather than stranding the re-routed frames on a dead
+            # queue until the op deadline.  Concurrent multi-lane faults
+            # stay fail-stop — exactly the legacy contract.
+            raise CommunicatorError(
+                f"lane {key} failover target lane {ent['surv']} died "
+                "mid-handshake; poisoning the epoch"
+            )
+        missing = self._tx_seq.get(key, 0) - ent["peer_rx"]
+        log = ctx.sent_log.get(key, [])
+        if missing < 0 or missing > len(log):
+            raise CommunicatorError(
+                f"lane {key} failover lost {missing} sub-frames beyond the "
+                "current collective; cannot replay"
+            )
+        replay: List[dict] = []
+        if missing:
+            replay = log[-missing:]
+            del log[-missing:]
+            self._tx_seq[key] = ent["peer_rx"]
+        moved = replay + ent["sends"]
+        for frame in moved:
+            _rearm_frame(frame)
+        if moved:
+            ctx.send_q.setdefault(surv_key, []).extend(moved)
+        if ent["recvs"]:
+            ctx.recv_q.setdefault(surv_key, []).extend(ent["recvs"])
+        self.dead_lanes.setdefault(peer, set()).add(ln)
+        self.lane_failovers += 1
+        ctx.frame_gates.pop(key, None)
+        logger.warning(
+            "lane %s failed over: %d outstanding sub-frames re-routed to "
+            "lane %d",
+            key,
+            len(moved) + len(ent["recvs"]),
+            ent["surv"],
+        )
 
     def striped_drain(
         self,
@@ -1643,12 +2471,22 @@ class _TcpMesh:
 def _recv_exact(
     sock: socket.socket, n: int, aborted: threading.Event, timeout_s: float
 ) -> bytes:
-    sock.settimeout(timeout_s)
+    # poll in short slices (capped by the remaining deadline) so an abort
+    # latched by a peer propagates in ~250 ms instead of parking in the
+    # kernel for the full op timeout before ``aborted`` is re-checked
+    deadline = time.monotonic() + timeout_s
     out = b""
     while len(out) < n:
         if aborted.is_set():
             raise CommunicatorAborted("communicator aborted")
-        chunk = sock.recv(n - len(out))
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"recv timed out after {timeout_s}s")
+        sock.settimeout(min(0.25, remaining))
+        try:
+            chunk = sock.recv(n - len(out))
+        except socket.timeout:
+            continue
         if not chunk:
             raise CommunicatorError("connection closed during recv")
         out += chunk
@@ -1690,6 +2528,9 @@ class TCPCommunicator(Communicator):
         self._timeout_s = timeout_s
         self._host_id = host_id
         self._hier = hierarchical
+        # runtime-armed fault program (chaos hook); None = follow the
+        # TORCHFT_NET_FAULTS env
+        self._fault_override: Optional[_FaultProgram] = None
         self._mesh: Optional[_TcpMesh] = None
         self._rank = 0
         self._world_size = 1
@@ -1736,6 +2577,7 @@ class TCPCommunicator(Communicator):
                 self._timeout_s,
                 host_id=self._host_id,
                 hier=self._hier,
+                faults=self._fault_override,
             )
 
         with self._lock:
@@ -1807,11 +2649,27 @@ class TCPCommunicator(Communicator):
     def set_timeout(self, timeout_s: float) -> None:
         self._timeout_s = timeout_s
 
+    def arm_faults(self, spec: Union[str, _FaultProgram, None]) -> None:
+        """Arm (or with ``None`` disarm) a per-link fault program at
+        runtime — the chaos hook that flips a healthy link flaky
+        mid-collective.  Applies to the CURRENT epoch's mesh immediately
+        and to every future epoch of this communicator; ``None`` falls back
+        to the ``TORCHFT_NET_FAULTS`` env program."""
+        prog = parse_fault_spec(spec) if isinstance(spec, str) else spec
+        self._fault_override = prog
+        mesh = self._mesh
+        if mesh is not None:
+            mesh.faults = prog if prog is not None else _net_faults_from_env()
+        logger.info(
+            "fault program %s", "armed" if prog is not None else "disarmed"
+        )
+
     def lane_stats(self) -> Dict[str, object]:
         """Per-lane observability of the current epoch's mesh: lane count,
-        payload bytes sent/received per lane, and stall events (pacer
-        denials / kernel would-block) per lane.  Empty when unconfigured or
-        single-member."""
+        payload bytes sent/received per lane, stall events (pacer denials /
+        kernel would-block) per lane, and the gray-failure counters
+        (in-epoch lane reconnects/failovers, injected faults).  Empty when
+        unconfigured or single-member."""
         mesh = self._mesh
         if mesh is None:
             return {}
@@ -1821,6 +2679,10 @@ class TCPCommunicator(Communicator):
             "lane_tx_bytes": list(mesh.lane_tx_bytes),
             "lane_rx_bytes": list(mesh.lane_rx_bytes),
             "lane_stalls": list(mesh.lane_stalls),
+            "lane_reconnects": mesh.lane_reconnects,
+            "lane_failovers": mesh.lane_failovers,
+            "faults_injected": mesh.faults_injected,
+            "dead_lanes": sum(len(v) for v in mesh.dead_lanes.values()),
         }
         if mesh.topo is not None:
             stats.update(
@@ -2840,6 +3702,9 @@ class FakeCommunicatorWrapper(Communicator):
     def lane_stats(self) -> Dict[str, object]:
         return self._comm.lane_stats()
 
+    def arm_faults(self, spec) -> None:
+        self._comm.arm_faults(spec)  # type: ignore[attr-defined]
+
     def hier_topology(self) -> Optional[Dict[str, object]]:
         return self._comm.hier_topology()
 
@@ -2919,6 +3784,9 @@ class ManagedCommunicator(Communicator):
 
     def lane_stats(self) -> Dict[str, object]:
         return self._manager._comm.lane_stats()
+
+    def arm_faults(self, spec) -> None:
+        self._manager._comm.arm_faults(spec)
 
     def hier_topology(self) -> Optional[Dict[str, object]]:
         return self._manager._comm.hier_topology()

@@ -25,7 +25,9 @@ TPU-first layout: the mesh never sees the replica count.
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
@@ -37,6 +39,265 @@ logger = logging.getLogger(__name__)
 
 class _Die(Exception):
     pass
+
+
+def gray_failure_drill(
+    num_replicas: int = 3,
+    steps: int = 12,
+    mode: str = "net_flaky",
+    fault_spec: Optional[str] = None,
+    lanes: int = 2,
+    payload_elems: int = 300_000,
+    arm_at_step: int = 3,
+    timeout_s: float = 20.0,
+    evict_persist: int = 2,
+) -> Dict[str, Any]:
+    """Gray-failure chaos drill: a real fleet (lighthouse + one Manager +
+    TCPCommunicator per replica, threads in one process) stepping a plain
+    allreduce loop while a typed gray failure is armed mid-run via
+    :class:`~torchft_tpu.chaos.ChaosController`.
+
+    Modes (one :class:`~torchft_tpu.chaos.Failure` class each):
+
+    - ``net_flaky``: EVERY replica's link turns flaky (frame loss +
+      occasional connection resets) after ``arm_at_step`` commits.  The
+      fleet must finish all ``steps`` with ZERO quorum reconfigurations —
+      recovery stays in-epoch — and nonzero lane reconnects.
+    - ``slow_nic``: one replica's NIC turns persistently slow.  With
+      ``TORCHFT_EVICT_SLOW=1`` (set by the drill) the lighthouse must flag
+      it from heartbeat comm-health and shed it from the quorum; the
+      surviving fleet's step time must recover.
+    - ``partition``: one replica is cut off (data-plane partition mask +
+      paused heartbeats).  The MAJORITY side must form a quorum without it
+      (anti split-brain keeps the minority down).
+
+    Returns summary facts (also asserted internally)."""
+    from torchft_tpu.chaos import ChaosController, Failure, ThreadReplica
+    from torchft_tpu.communicator import TCPCommunicator
+    from torchft_tpu.lighthouse import LighthouseServer
+    from torchft_tpu.manager import Manager
+
+    assert mode in ("net_flaky", "slow_nic", "partition"), mode
+    assert num_replicas >= 3, "gray drills need a majority side"
+    failure = {
+        "net_flaky": Failure.NET_FLAKY,
+        "slow_nic": Failure.SLOW_NIC,
+        "partition": Failure.PARTITION,
+    }[mode]
+
+    saved_env = {
+        k: os.environ.get(k)
+        for k in (
+            "TORCHFT_RING_LANES",
+            "TORCHFT_EVICT_SLOW",
+            "TORCHFT_EVICT_PERSIST",
+            "TORCHFT_EVICT_MIN_STALL_RATE",
+        )
+    }
+    os.environ["TORCHFT_RING_LANES"] = str(lanes)
+    if mode == "slow_nic":
+        os.environ["TORCHFT_EVICT_SLOW"] = "1"
+        os.environ["TORCHFT_EVICT_PERSIST"] = str(evict_persist)
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=num_replicas - 1,
+        join_timeout_ms=300,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=1500,
+    )
+
+    class _Replica:
+        def __init__(self, idx: int) -> None:
+            self.idx = idx
+            self.comm = TCPCommunicator(timeout_s=timeout_s)
+            self.manager = Manager(
+                comm=self.comm,
+                load_state_dict=None,
+                state_dict=None,
+                min_replica_size=num_replicas - 1,
+                replica_id=f"gray_{idx}",
+                lighthouse_addr=lighthouse.local_address(),
+                timeout=timeout_s,
+                quorum_timeout=timeout_s,
+                connect_timeout=timeout_s,
+            )
+            self.commits = 0
+            self.reconfigs_after_arm = 0
+            self.qid_at_arm: Optional[int] = None
+            self.step_times: List[float] = []
+            self.excluded = False
+
+    rng = np.random.default_rng(7)
+    grad = rng.normal(size=payload_elems).astype(np.float32)
+    replicas = [_Replica(i) for i in range(num_replicas)]
+    victim_idx = num_replicas - 1
+    armed = threading.Event()
+    stop = threading.Event()
+    chaos = ChaosController(
+        [ThreadReplica(f"gray_{r.idx}", r) for r in replicas]
+    )
+
+    def replica_main(rep: _Replica) -> None:
+        # replicas step until the main thread calls the drill over — an
+        # early solo exit would itself shrink the quorum and masquerade as
+        # a gray-failure reconfiguration
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                rep.manager.start_quorum()
+                work = rep.manager.allreduce(grad.copy())
+                work.wait(timeout=timeout_s)
+                ok = rep.manager.should_commit()
+            except Exception:  # noqa: BLE001 — a gray step is a failed vote
+                ok = False
+            if ok and not stop.is_set():
+                rep.commits += 1
+                rep.step_times.append(time.monotonic() - t0)
+                if (
+                    armed.is_set()
+                    and rep.qid_at_arm is not None
+                    and rep.manager._quorum_id != rep.qid_at_arm
+                ):
+                    rep.reconfigs_after_arm += 1
+                    rep.qid_at_arm = rep.manager._quorum_id
+            elif armed.is_set() and rep.idx == victim_idx and mode != "net_flaky":
+                # the shed/partitioned victim stops burning quorum RPCs once
+                # the fleet has visibly moved on without it
+                status = lighthouse._status()
+                ids = [p["replica_id"] for p in status["participants"]]
+                if all(not i.startswith(f"gray_{victim_idx}") for i in ids):
+                    rep.excluded = True
+                    return
+
+    threads = [
+        threading.Thread(target=replica_main, args=(r,), daemon=True)
+        for r in replicas
+    ]
+    result: Dict[str, Any] = {}
+    try:
+        for t in threads:
+            t.start()
+        # let the fleet form and commit a few clean steps, then arm
+        deadline = time.monotonic() + 120.0
+        while (
+            min(r.commits for r in replicas) < arm_at_step
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert min(r.commits for r in replicas) >= arm_at_step, (
+            "fleet never reached the arming step"
+        )
+        # snapshot the steady-state quorum id BEFORE arming: any bump past
+        # this point is a reconfiguration the gray failure caused
+        for r in replicas:
+            r.qid_at_arm = r.manager._quorum_id
+        spec_kw = {"spec": fault_spec} if fault_spec is not None else {}
+        if mode == "net_flaky":
+            # every link turns flaky at once — the hardest in-epoch case
+            for handle in chaos.replicas:
+                chaos.inject(failure, victim=handle, **spec_kw)
+        else:
+            chaos.inject(failure, victim=chaos.replicas[victim_idx], **spec_kw)
+        armed.set()
+
+        if mode == "net_flaky":
+            deadline = time.monotonic() + 240.0
+            while (
+                min(r.commits for r in replicas) < steps
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            stop.set()
+            for t in threads:
+                t.join(timeout=2 * timeout_s + 10.0)
+            assert all(r.commits >= steps for r in replicas), (
+                f"fleet stalled under {mode}: "
+                f"{[r.commits for r in replicas]}"
+            )
+            reconfigs = sum(r.reconfigs_after_arm for r in replicas)
+            health = [r.manager._comm_health() for r in replicas]
+            reconnects = sum(h.reconnects for h in health)
+            faults = sum(h.faults for h in health)
+            assert reconfigs == 0, (
+                f"{reconfigs} quorum reconfigurations under net_flaky "
+                "(recovery must stay in-epoch)"
+            )
+            assert faults > 0, "fault program never fired"
+            result.update(
+                quorum_reconfigs=reconfigs,
+                lane_reconnects=reconnects,
+                faults_injected=faults,
+            )
+        else:
+            # survivors must finish; the victim must end up excluded (per
+            # the lighthouse's own quorum view — no need to wait out the
+            # victim's quorum-RPC timeout cycles)
+            survivors = [r for r in replicas if r.idx != victim_idx]
+            deadline = time.monotonic() + 240.0
+            victim_out = False
+            while (
+                min(r.commits for r in survivors) < steps or not victim_out
+            ) and time.monotonic() < deadline:
+                time.sleep(0.2)
+                ids = [
+                    p["replica_id"]
+                    for p in lighthouse._status()["participants"]
+                ]
+                victim_out = bool(ids) and all(
+                    not i.startswith(f"gray_{victim_idx}") for i in ids
+                )
+            stop.set()
+            for t in threads:
+                t.join(timeout=2 * timeout_s + 10.0)
+            assert all(r.commits >= steps for r in survivors), (
+                f"survivors stalled under {mode}: "
+                f"{[r.commits for r in survivors]}"
+            )
+            status = lighthouse._status()
+            ids = [p["replica_id"] for p in status["participants"]]
+            assert all(
+                not i.startswith(f"gray_{victim_idx}") for i in ids
+            ), f"victim still in quorum under {mode}: {ids}"
+            if mode == "slow_nic":
+                assert status["evictions_total"] >= 1, status
+                # step time must RECOVER once the straggler is shed: the
+                # last post-eviction steps vs the pre-arm baseline
+                base = [
+                    float(np.median(r.step_times[:arm_at_step]))
+                    for r in survivors
+                ]
+                # median of the last 5 so one straggling in-flight step
+                # (e.g. blocked on the victim's final epoch) can't skew
+                # the recovered figure
+                tail = [
+                    float(np.median(r.step_times[-5:])) for r in survivors
+                ]
+                result.update(
+                    step_time_clean_s=float(np.mean(base)),
+                    step_time_recovered_s=float(np.mean(tail)),
+                )
+            result.update(
+                victim_excluded=True,
+                evictions_total=status["evictions_total"],
+            )
+        result["commits"] = [r.commits for r in replicas]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        for r in replicas:
+            try:
+                r.manager.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        lighthouse.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return result
 
 
 def joint_ft_spmd_drill(

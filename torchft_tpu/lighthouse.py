@@ -32,6 +32,7 @@ import argparse
 import html
 import json
 import logging
+import os
 import socket
 import threading
 import time
@@ -39,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from torchft_tpu.wire import (
+    CommHealth,
     ErrCode,
     MsgType,
     Quorum,
@@ -57,6 +59,42 @@ from torchft_tpu.wire import (
 )
 
 logger = logging.getLogger(__name__)
+
+# Straggler detection / eviction knobs.  Heartbeats carry a cumulative
+# comm-health summary (wire.CommHealth); the lighthouse differences
+# consecutive beats into EWMA rates and flags a replica whose stall rate is
+# a persistent outlier vs its peers.  With TORCHFT_EVICT_SLOW=1 a flagged
+# replica is excluded from the next quorum (never below min_replicas or
+# the anti-split-brain majority), so the fleet sheds a gray node
+# proactively instead of timing out on it every step.
+EVICT_SLOW_ENV = "TORCHFT_EVICT_SLOW"
+# flag when stall_rate > ratio x median(peer stall rates) ...
+EVICT_RATIO_ENV = "TORCHFT_EVICT_RATIO"  # default 4.0
+# ... AND above this absolute floor (events/s) — so an idle fleet where
+# everyone is near zero never flags anybody
+EVICT_MIN_STALL_RATE_ENV = "TORCHFT_EVICT_MIN_STALL_RATE"  # default 20.0
+# consecutive outlier evaluations (one per heartbeat) before flagging
+EVICT_PERSIST_ENV = "TORCHFT_EVICT_PERSIST"  # default 3
+
+
+def _evict_slow_enabled() -> bool:
+    return os.environ.get(EVICT_SLOW_ENV, "0").lower() in ("1", "true", "on")
+
+
+def _evict_knobs() -> Tuple[float, float, int]:
+    try:
+        ratio = float(os.environ.get(EVICT_RATIO_ENV, "") or 4.0)
+        min_rate = float(os.environ.get(EVICT_MIN_STALL_RATE_ENV, "") or 20.0)
+        persist = int(os.environ.get(EVICT_PERSIST_ENV, "") or 3)
+    except ValueError as e:
+        raise ValueError(
+            f"unparseable eviction knob: {EVICT_RATIO_ENV}="
+            f"{os.environ.get(EVICT_RATIO_ENV)!r} "
+            f"{EVICT_MIN_STALL_RATE_ENV}="
+            f"{os.environ.get(EVICT_MIN_STALL_RATE_ENV)!r} "
+            f"{EVICT_PERSIST_ENV}={os.environ.get(EVICT_PERSIST_ENV)!r}"
+        ) from e
+    return ratio, min_rate, max(1, persist)
 
 
 @dataclass
@@ -77,11 +115,97 @@ class _MemberDetails:
 
 
 @dataclass
+class _ReplicaHealth:
+    """Per-replica comm-health aggregate differenced from heartbeats."""
+
+    last: Optional[CommHealth] = None
+    last_ts: float = 0.0
+    stall_rate: float = 0.0  # EWMA, events/s
+    reconnect_rate: float = 0.0  # EWMA, events/s
+    tx_rate: float = 0.0  # EWMA, bytes/s
+    reconnects: int = 0  # cumulative, straight from the last beat
+    failovers: int = 0
+    flag_streak: int = 0
+    flagged: bool = False
+
+
+@dataclass
 class _State:
     participants: Dict[str, _MemberDetails] = field(default_factory=dict)
     heartbeats: Dict[str, float] = field(default_factory=dict)
     prev_quorum: Optional[Quorum] = None
     quorum_id: int = 0
+    health: Dict[str, _ReplicaHealth] = field(default_factory=dict)
+    evicted_now: List[str] = field(default_factory=list)
+    evicted_prev: set = field(default_factory=set)
+    evictions_total: int = 0
+
+
+# health entries stop counting as straggler-median "reporters" after this
+# many seconds without a beat, and are dropped entirely at 4x — a departed
+# replica's frozen rate must not skew the peer median (or satisfy the
+# >= 3-reporters guard) forever, and replica-id churn must not grow the map
+# unboundedly
+_HEALTH_STALE_S = 15.0
+
+
+def note_health(state: _State, replica_id: str, health: CommHealth, now: float) -> None:
+    """Fold one heartbeat's cumulative comm-health counters into the
+    replica's EWMA rates, then re-evaluate the outlier flags.  Pure on
+    ``state`` (caller holds the server lock); driven directly by tests."""
+    for rid in [
+        r
+        for r, rh in state.health.items()
+        if now - rh.last_ts > 4 * _HEALTH_STALE_S
+    ]:
+        del state.health[rid]
+    h = state.health.setdefault(replica_id, _ReplicaHealth())
+    if h.last is not None and now > h.last_ts:
+        dt = now - h.last_ts
+        alpha = min(1.0, dt / 5.0)  # ~5 s horizon
+        stall_rate = max(0, health.stalls - h.last.stalls) / dt
+        reconnect_rate = max(0, health.reconnects - h.last.reconnects) / dt
+        tx_rate = max(0, health.tx_bytes - h.last.tx_bytes) / dt
+        h.stall_rate += alpha * (stall_rate - h.stall_rate)
+        h.reconnect_rate += alpha * (reconnect_rate - h.reconnect_rate)
+        h.tx_rate += alpha * (tx_rate - h.tx_rate)
+    h.last = health
+    h.last_ts = now
+    h.reconnects = health.reconnects
+    h.failovers = health.failovers
+    _evaluate_stragglers(state, replica_id, now)
+
+
+def _evaluate_stragglers(state: _State, updated_id: str, now: float) -> None:
+    """Flag ``updated_id`` when its stall rate is a persistent outlier vs
+    its peers.  Needs >= 3 FRESH reporting replicas (with 2 there is no
+    majority to say which side is 'normal'; a departed replica's frozen
+    rate must not stand in as a reporter)."""
+    ratio, min_rate, persist = _evict_knobs()
+    rates = {
+        rid: rh.stall_rate
+        for rid, rh in state.health.items()
+        if rh.last and now - rh.last_ts <= _HEALTH_STALE_S
+    }
+    h = state.health[updated_id]
+    if len(rates) < 3:
+        h.flag_streak, h.flagged = 0, False
+        return
+    others = sorted(r for rid, r in rates.items() if rid != updated_id)
+    median = others[len(others) // 2]
+    if h.stall_rate > max(ratio * median, min_rate):
+        h.flag_streak += 1
+    else:
+        h.flag_streak = 0
+        h.flagged = False
+    if h.flag_streak >= persist and not h.flagged:
+        h.flagged = True
+        logger.warning(
+            "straggler flagged: %s stall_rate=%.1f/s vs peer median %.1f/s",
+            updated_id,
+            h.stall_rate,
+            median,
+        )
 
 
 def quorum_compute(
@@ -105,9 +229,33 @@ def quorum_compute(
     )
     shrink_only = any(d.member.shrink_only for d in healthy_participants.values())
 
+    # straggler eviction (TORCHFT_EVICT_SLOW): exclude persistently-flagged
+    # gray replicas from the candidate set — BEFORE the fast-quorum path,
+    # so even a fully-healthy-looking round sheds the straggler — but never
+    # below min_replicas or the anti-split-brain majority (a gray node is
+    # still better than no quorum)
+    state.evicted_now = []
+    if _evict_slow_enabled():
+        flagged = {rid for rid, rh in state.health.items() if rh.flagged}
+        keep = [m for m in candidates if m.replica_id not in flagged]
+        if (
+            len(keep) < len(candidates)
+            and len(keep) >= cfg.min_replicas
+            and len(keep) > len(healthy_replicas) // 2
+        ):
+            state.evicted_now = sorted(
+                m.replica_id for m in candidates if m.replica_id in flagged
+            )
+            candidates = keep
+
     metadata = (
         f"[{len(healthy_participants)}/{len(state.participants)} participants healthy]"
         f"[{len(healthy_replicas)} heartbeating][shrink_only={shrink_only}]"
+        + (
+            f"[evicting slow: {', '.join(state.evicted_now)}]"
+            if state.evicted_now
+            else ""
+        )
     )
 
     if state.prev_quorum is not None:
@@ -264,6 +412,18 @@ class LighthouseServer:
             m.replica_id for m in participants if m.commit_failures > 0
         ]
         state = self._state
+        # eviction accounting is transition-based: a replica entering the
+        # evicted set of an ISSUED quorum counts once per continuous
+        # eviction episode, independent of membership-change ordering
+        newly_shed = [
+            r for r in state.evicted_now if r not in state.evicted_prev
+        ]
+        state.evicted_prev = set(state.evicted_now)
+        if newly_shed:
+            state.evictions_total += len(newly_shed)
+            logger.warning(
+                "quorum sheds slow replica(s): %s", ", ".join(newly_shed)
+            )
         if state.prev_quorum is None or _quorum_changed(
             participants, state.prev_quorum.participants
         ):
@@ -344,8 +504,16 @@ class LighthouseServer:
                     self._handle_quorum(conn, r)
                 elif msg_type == MsgType.LH_HEARTBEAT_REQ:
                     replica_id = r.string()
+                    # optional comm-health tail (flag byte + CommHealth);
+                    # absent on legacy clients
+                    health = None
+                    if not r.done() and r.u8():
+                        health = CommHealth.decode(r)
                     with self._lock:
-                        self._state.heartbeats[replica_id] = time.monotonic()
+                        now = time.monotonic()
+                        self._state.heartbeats[replica_id] = now
+                        if health is not None:
+                            note_health(self._state, replica_id, health, now)
                     send_frame(conn, MsgType.LH_HEARTBEAT_RESP)
                 elif msg_type == MsgType.LH_STATUS_REQ:
                     send_frame(
@@ -435,7 +603,12 @@ class LighthouseServer:
     def _status(self) -> dict:
         with self._lock:
             now = time.monotonic()
+            # quorum_compute writes state.evicted_now (the tick loop's
+            # eviction-accounting channel); a status read must stay
+            # side-effect free, so snapshot and restore it
+            saved_evicted = list(self._state.evicted_now)
             _, reason = quorum_compute(now, self._state, self._cfg)
+            self._state.evicted_now = saved_evicted
             prev = self._state.prev_quorum
             max_step = (
                 max((p.step for p in prev.participants), default=-1) if prev else -1
@@ -469,6 +642,23 @@ class LighthouseServer:
                 "heartbeats": {
                     rid: now - ts for rid, ts in self._state.heartbeats.items()
                 },
+                # gray-failure health column: per-replica comm-health rates
+                # (from heartbeat CommHealth summaries) + straggler flags
+                "health": {
+                    rid: {
+                        "stall_rate": round(h.stall_rate, 1),
+                        "reconnect_rate": round(h.reconnect_rate, 3),
+                        "tx_rate": round(h.tx_rate, 1),
+                        "lane_reconnects": h.reconnects,
+                        "lane_failovers": h.failovers,
+                        "flagged": h.flagged,
+                    }
+                    for rid, h in self._state.health.items()
+                    if h.last is not None
+                },
+                "evict_slow_enabled": _evict_slow_enabled(),
+                "evicted_replicas": list(self._state.evicted_now),
+                "evictions_total": self._state.evictions_total,
             }
 
     def _handle_http(self, conn: socket.socket) -> None:
@@ -541,6 +731,24 @@ class LighthouseServer:
             f"<li><code>{html.escape(rid)}</code>: {age:.1f}s ago</li>"
             for rid, age in sorted(s["heartbeats"].items())
         )
+        health_rows = "".join(
+            f"<tr><td><code>{html.escape(rid)}</code></td>"
+            f"<td>{h['stall_rate']}</td><td>{h['lane_reconnects']}</td>"
+            f"<td>{h['lane_failovers']}</td>"
+            f"<td>{'FLAGGED' if h['flagged'] else 'ok'}</td></tr>"
+            for rid, h in sorted(s["health"].items())
+        )
+        health_tbl = (
+            "<h2>comm health</h2><table border=1 cellpadding=4>"
+            "<tr><th>replica</th><th>stall rate /s</th><th>reconnects</th>"
+            "<th>failovers</th><th>status</th></tr>"
+            f"{health_rows}</table>"
+            f"<p>evict_slow={'on' if s['evict_slow_enabled'] else 'off'}"
+            f" · evicted now={html.escape(', '.join(s['evicted_replicas']) or 'none')}"
+            f" · evictions_total={s['evictions_total']}</p>"
+            if health_rows
+            else ""
+        )
         return (
             "<html><head><title>torchft_tpu lighthouse</title><style>"
             "body{font-family:monospace;margin:2em}.card{border:1px solid #999;"
@@ -550,7 +758,7 @@ class LighthouseServer:
             f"<p>max_step={s['max_step']} · participants={s['num_participants']}"
             f" · heal sources={s['num_heal_sources']}"
             f" · lagging={html.escape(', '.join(s['lagging_replicas']) or 'none')}</p>"
-            f"{cards}<h2>heartbeats</h2><ul>{beats}</ul></body></html>"
+            f"{cards}{health_tbl}<h2>heartbeats</h2><ul>{beats}</ul></body></html>"
         )
 
 
@@ -594,14 +802,28 @@ class LighthouseClient(RpcClient):
         raise_if_error(msg_type, r)
         return Quorum.decode(r)
 
-    def heartbeat(self, replica_id: str, timeout: float = 5.0) -> None:
+    def heartbeat(
+        self,
+        replica_id: str,
+        timeout: float = 5.0,
+        health: Optional[CommHealth] = None,
+    ) -> None:
+        """Heartbeat, optionally carrying a cumulative comm-health summary
+        (straggler detection input).  Idempotent: one reconnect-retry rides
+        out a lighthouse connection blip instead of crashing the sender."""
+        w = Writer().string(replica_id)
+        if health is not None:
+            w.u8(1)
+            health.encode(w)
         msg_type, r = self.call(
-            MsgType.LH_HEARTBEAT_REQ, Writer().string(replica_id).payload(), timeout
+            MsgType.LH_HEARTBEAT_REQ, w.payload(), timeout, idempotent=True
         )
         raise_if_error(msg_type, r)
 
     def status(self, timeout: float = 5.0) -> dict:
-        msg_type, r = self.call(MsgType.LH_STATUS_REQ, b"", timeout)
+        msg_type, r = self.call(
+            MsgType.LH_STATUS_REQ, b"", timeout, idempotent=True
+        )
         raise_if_error(msg_type, r)
         return json.loads(r.string())
 

@@ -191,6 +191,23 @@ class Manager:
         self._batches_committed = 0
         self._commit_failures = 0
         self._quorum_id = -1
+        # job-lifetime comm-health counters: completed epochs fold in at
+        # each quorum change, live-epoch values ride on top — heartbeats
+        # carry the (monotonic) sum to the lighthouse for straggler
+        # detection
+        self._comm_health_base: Dict[str, int] = {
+            "stalls": 0,
+            "reconnects": 0,
+            "failovers": 0,
+            "faults": 0,
+            "tx_bytes": 0,
+            "rx_bytes": 0,
+        }
+        # True between "outgoing epoch folded into base" and "mesh
+        # reconfigured (live counters reset)": heartbeats landing in that
+        # window must report base-only, or the outgoing epoch would count
+        # twice and spike the lighthouse's stall-rate EWMA
+        self._comm_health_folding = False
         self._quorum_future: Optional[concurrent.futures.Future] = None
         # phase wall-times of the most recent quorum round (see _async_quorum)
         self.last_quorum_timings: Dict[str, float] = {}
@@ -265,6 +282,7 @@ class Manager:
                 heartbeat_interval=heartbeat_interval,
                 connect_timeout=self._connect_timeout,
                 quorum_retries=quorum_retries,
+                health_fn=self._comm_health,
             )
             self._store.set(MANAGER_ADDR_KEY, self._manager_server.address().encode())
             self._store.set(REPLICA_ID_KEY, replica_id.encode())
@@ -316,6 +334,33 @@ class Manager:
     def load_state_dict(self, state_dict: Dict[str, int]) -> None:
         self._step = state_dict["step"]
         self._batches_committed = state_dict["batches_committed"]
+
+    # ------------------------------------------------------------------
+    # comm health (straggler-detection input)
+    # ------------------------------------------------------------------
+
+    def _comm_health(self):
+        """Cumulative comm-health snapshot for the heartbeat: completed
+        epochs' fold plus the live epoch's ``lane_stats()``."""
+        from torchft_tpu.wire import CommHealth
+
+        base = self._comm_health_base
+        stats_fn = getattr(self._comm, "lane_stats", None)
+        live = (
+            {}
+            if self._comm_health_folding
+            else stats_fn() if callable(stats_fn) else {}
+        )
+        return CommHealth(
+            stalls=base["stalls"] + sum(live.get("lane_stalls") or []),
+            reconnects=base["reconnects"]
+            + int(live.get("lane_reconnects", 0) or 0),
+            failovers=base["failovers"]
+            + int(live.get("lane_failovers", 0) or 0),
+            faults=base["faults"] + int(live.get("faults_injected", 0) or 0),
+            tx_bytes=base["tx_bytes"] + sum(live.get("lane_tx_bytes") or []),
+            rx_bytes=base["rx_bytes"] + sum(live.get("lane_rx_bytes") or []),
+        )
 
     # ------------------------------------------------------------------
     # error funnel
@@ -492,7 +537,45 @@ class Manager:
                     comm_lane_tx_bytes=prev_lane_stats.get("lane_tx_bytes"),
                     comm_lane_rx_bytes=prev_lane_stats.get("lane_rx_bytes"),
                     comm_lane_stalls=prev_lane_stats.get("lane_stalls"),
+                    comm_lane_reconnects=prev_lane_stats.get(
+                        "lane_reconnects", 0
+                    ),
+                    comm_lane_failovers=prev_lane_stats.get(
+                        "lane_failovers", 0
+                    ),
+                    comm_injected_faults=prev_lane_stats.get(
+                        "faults_injected", 0
+                    ),
                 )
+                # fold the OUTGOING epoch's counters into the job-lifetime
+                # base the heartbeat health summary reports from; from here
+                # until the fresh mesh is configured the live counters are
+                # already IN the base, so heartbeats report base-only
+                self._comm_health_folding = True
+                base = self._comm_health_base
+                base["stalls"] += sum(prev_lane_stats.get("lane_stalls") or [])
+                base["reconnects"] += int(
+                    prev_lane_stats.get("lane_reconnects", 0) or 0
+                )
+                base["failovers"] += int(
+                    prev_lane_stats.get("lane_failovers", 0) or 0
+                )
+                base["faults"] += int(
+                    prev_lane_stats.get("faults_injected", 0) or 0
+                )
+                base["tx_bytes"] += sum(
+                    prev_lane_stats.get("lane_tx_bytes") or []
+                )
+                base["rx_bytes"] += sum(
+                    prev_lane_stats.get("lane_rx_bytes") or []
+                )
+                # gray-failure counters next to the phase wall-times, so a
+                # drill can assert in-epoch recovery without scraping logs
+                timings["comm_lane_reconnects"] = float(
+                    base["reconnects"]
+                )
+                timings["comm_lane_failovers"] = float(base["failovers"])
+                timings["comm_injected_faults"] = float(base["faults"])
                 if prev_lane_stats.get("topo_hosts"):
                     # hierarchical-topology counters of the outgoing epoch:
                     # host grouping + shared-memory bytes that never touched
@@ -534,6 +617,7 @@ class Manager:
                 self.report_error(e)
                 return
             finally:
+                self._comm_health_folding = False
                 timings["configure_s"] = time.monotonic() - t_cfg
             # lane layout of the fresh epoch (benches/operators read it from
             # last_quorum_timings next to the phase wall-times)

@@ -174,6 +174,7 @@ class ManagerServer:
         connect_timeout: float = 10.0,
         quorum_retries: int = 0,
         kill_fn: Optional[Callable[[str], None]] = None,
+        health_fn: Optional[Callable[[], Optional[object]]] = None,
     ) -> None:
         self._replica_id = replica_id
         self._lighthouse_addr = lighthouse_addr
@@ -184,6 +185,15 @@ class ManagerServer:
         self._connect_timeout = connect_timeout
         self._quorum_retries = quorum_retries
         self._kill_fn = kill_fn or self._default_kill
+        # comm-health provider: each heartbeat carries its latest snapshot
+        # (a wire.CommHealth or None) to the lighthouse — the straggler-
+        # detection input.  Errors are swallowed: a broken probe must never
+        # kill the heartbeat that keeps this replica in the quorum.
+        self._health_fn = health_fn
+        # chaos hook (Failure.PARTITION): a partitioned replica loses its
+        # control plane too, so the drill pauses heartbeats alongside the
+        # data-plane partition mask
+        self.heartbeat_paused = False
 
         self._lock = threading.Condition()
         # quorum barrier state
@@ -256,12 +266,21 @@ class ManagerServer:
         """Heartbeat the lighthouse until shutdown (``src/manager.rs:194-216``)."""
         client: Optional[LighthouseClient] = None
         while not self._shutdown:
+            if self.heartbeat_paused:
+                time.sleep(self._heartbeat_interval)
+                continue
+            health = None
+            if self._health_fn is not None:
+                try:
+                    health = self._health_fn()
+                except Exception:  # noqa: BLE001 — probe must not kill beats
+                    health = None
             try:
                 if client is None:
                     client = LighthouseClient(
                         self._lighthouse_addr, connect_timeout=self._connect_timeout
                     )
-                client.heartbeat(self._replica_id)
+                client.heartbeat(self._replica_id, health=health)
             except (OSError, TimeoutError, WireError) as e:
                 logger.info(
                     "[Replica %s] failed to send heartbeat to lighthouse: %s",
@@ -422,6 +441,12 @@ class ManagerServer:
         quorum: Optional[Quorum] = None
         last_err = "unknown"
         for attempt in range(self._quorum_retries + 1):
+            if self.heartbeat_paused:
+                # chaos partition: the control plane is severed — a quorum
+                # rpc is an implicit lighthouse heartbeat, so forwarding it
+                # would keep this "partitioned" replica looking alive
+                last_err = "control plane severed (chaos partition)"
+                break
             try:
               with self._lh_client_lock:
                 # persistent connection across rounds (the reference keeps a

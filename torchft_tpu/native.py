@@ -421,9 +421,15 @@ class CppManagerServer:
         heartbeat_interval: float = 0.1,
         connect_timeout: float = 10.0,
         quorum_retries: int = 0,
+        health_fn: Optional[object] = None,
     ) -> None:
         import socket
 
+        # health_fn (comm-health heartbeat summaries for straggler
+        # detection) is accepted for construction parity with the Python
+        # ManagerServer but unused: the C++ sidecar sends legacy
+        # heartbeats, which the lighthouse treats as "no health report"
+        del health_fn
         lib = _load()
         assert lib is not None, "native runtime unavailable"
         self._lib = lib

@@ -57,6 +57,11 @@ _ATTR_KEYS = (
     "comm_lane_tx_bytes",
     "comm_lane_rx_bytes",
     "comm_lane_stalls",
+    # gray-failure counters (torchft_quorums; in-epoch lane recovery +
+    # fault injection of the outgoing epoch)
+    "comm_lane_reconnects",
+    "comm_lane_failovers",
+    "comm_injected_faults",
     # hierarchical-topology counters (torchft_quorums; host grouping +
     # shared-memory transport bytes of the outgoing epoch)
     "comm_topo_hosts",

@@ -172,10 +172,14 @@ class StoreClient(RpcClient):
         self._timeout = timeout
 
     def _call(
-        self, msg_type: MsgType, payload: bytes, timeout: Optional[float] = None
+        self,
+        msg_type: MsgType,
+        payload: bytes,
+        timeout: Optional[float] = None,
+        idempotent: bool = False,
     ) -> Reader:
         budget = self._timeout if timeout is None else timeout
-        resp_type, r = self.call(msg_type, payload, budget)
+        resp_type, r = self.call(msg_type, payload, budget, idempotent=idempotent)
         raise_if_error(resp_type, r)
         return r
 
@@ -184,10 +188,12 @@ class StoreClient(RpcClient):
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
         budget = self._timeout if timeout is None else timeout
+        # reads are idempotent: one reconnect-retry rides out a store blip
         r = self._call(
             MsgType.STORE_GET,
             Writer().string(key).u64(int(budget * 1000)).payload(),
             timeout=budget,
+            idempotent=True,
         )
         return r.blob()
 
@@ -196,7 +202,11 @@ class StoreClient(RpcClient):
         return r.i64()
 
     def exists(self, key: str) -> bool:
-        r = self._call(MsgType.STORE_EXISTS, Writer().string(key).payload())
+        r = self._call(
+            MsgType.STORE_EXISTS,
+            Writer().string(key).payload(),
+            idempotent=True,
+        )
         return r.boolean()
 
     def delete_prefix(self, prefix: str) -> int:

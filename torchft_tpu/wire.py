@@ -37,13 +37,24 @@ pyo3 timeout mapping in ``src/lib.rs:673-685``.
 from __future__ import annotations
 
 import os
+import random
 import socket
 import struct
+import time
 from dataclasses import dataclass, field
 from enum import IntEnum
 from typing import List, Optional, Tuple
 
 MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+# Dial attempts for control-plane connections (``connect()``), with
+# jittered exponential backoff between attempts, all inside the caller's
+# timeout budget — the analog of the reference's retry-with-backoff channel
+# helper (``src/net.rs:16-42``), so replicas racing a restarting
+# lighthouse/store don't die at dial time.
+CONNECT_RETRIES_ENV = "TORCHFT_CONNECT_RETRIES"
+_CONNECT_RETRIES_DEFAULT = 3
+_CONNECT_BACKOFF_BASE_S = 0.1
 
 # Wire version of the MGR_QUORUM_RESP body.  v1 is the original fixed field
 # order; v2 appends the striped-healing fields (every healthy peer's replica
@@ -266,6 +277,47 @@ class QuorumMember:
             shrink_only=r.boolean(),
             commit_failures=r.i64(),
             data=r.string(),
+        )
+
+
+@dataclass
+class CommHealth:
+    """Compact cumulative comm-health summary one replica reports with its
+    heartbeats (derived from ``Communicator.lane_stats()``): data-plane
+    stall events, in-epoch lane reconnects/failovers, injected faults, and
+    payload bytes moved.  Counters are job-lifetime cumulative so the
+    lighthouse can difference consecutive beats into rates.
+
+    Rides OPTIONALLY at the tail of ``LH_HEARTBEAT_REQ`` (flag byte +
+    fixed-width fields): a legacy server reads the replica id and ignores
+    the tail; a new server treats absence as "no health report"."""
+
+    stalls: int = 0
+    reconnects: int = 0
+    failovers: int = 0
+    faults: int = 0
+    tx_bytes: int = 0
+    rx_bytes: int = 0
+
+    def encode(self, w: Writer) -> None:
+        (
+            w.u64(self.stalls)
+            .u64(self.reconnects)
+            .u64(self.failovers)
+            .u64(self.faults)
+            .u64(self.tx_bytes)
+            .u64(self.rx_bytes)
+        )
+
+    @staticmethod
+    def decode(r: Reader) -> "CommHealth":
+        return CommHealth(
+            stalls=r.u64(),
+            reconnects=r.u64(),
+            failovers=r.u64(),
+            faults=r.u64(),
+            tx_bytes=r.u64(),
+            rx_bytes=r.u64(),
         )
 
 
@@ -495,16 +547,49 @@ def create_listener(bind: str, backlog: int = 512) -> socket.socket:
     raise last_err if last_err else OSError(f"cannot bind {bind!r}")
 
 
-def connect(addr: str, timeout: float) -> socket.socket:
-    """Dial ``host:port`` with a connect deadline.
+def connect(addr: str, timeout: float, retries: Optional[int] = None) -> socket.socket:
+    """Dial ``host:port`` with a connect deadline and bounded jittered
+    retry (the reference's channel helper retries with exponential backoff
+    and HTTP2 keepalives, ``src/net.rs:16-42``; TCP keepalive serves the
+    same dead-server-detection role here).
 
-    The reference's channel helper retries with exponential backoff and HTTP2
-    keepalives (``src/net.rs:16-42``); TCP keepalive serves the same
-    dead-server-detection role here.
-    """
+    A refused/unreachable dial is retried up to ``retries`` times
+    (``TORCHFT_CONNECT_RETRIES``, default 3) with jittered exponential
+    backoff, never exceeding the overall ``timeout`` budget — so a replica
+    racing a restarting lighthouse/store rides out the restart instead of
+    dying at dial time."""
     host, port_str = addr.rsplit(":", 1)
     host = host.strip("[]")
-    sock = socket.create_connection((host, int(port_str)), timeout=timeout)
+    if retries is None:
+        try:
+            retries = int(
+                os.environ.get(CONNECT_RETRIES_ENV, "")
+                or _CONNECT_RETRIES_DEFAULT
+            )
+        except ValueError as e:
+            raise ValueError(
+                f"unparseable {CONNECT_RETRIES_ENV}="
+                f"{os.environ.get(CONNECT_RETRIES_ENV)!r} (expected int)"
+            ) from e
+    deadline = time.monotonic() + timeout
+    attempt = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        try:
+            sock = socket.create_connection(
+                (host, int(port_str)), timeout=max(0.05, remaining)
+            )
+            break
+        except OSError:
+            attempt += 1
+            backoff = (
+                _CONNECT_BACKOFF_BASE_S
+                * (2 ** (attempt - 1))
+                * (0.5 + random.random())
+            )
+            if attempt > retries or time.monotonic() + backoff >= deadline:
+                raise
+            time.sleep(backoff)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
     return sock
@@ -551,22 +636,43 @@ class RpcClient:
                 pass
             self._sock = None
 
-    def call(self, msg_type: int, payload: bytes, timeout: float) -> tuple[int, Reader]:
+    def call(
+        self,
+        msg_type: int,
+        payload: bytes,
+        timeout: float,
+        idempotent: bool = False,
+    ) -> tuple[int, Reader]:
         """One rpc round-trip; raises ``TimeoutError`` on deadline and drops
-        the socket on any transport fault."""
+        the socket on any transport fault.
+
+        ``idempotent=True`` grants ONE bounded reconnect-retry after a
+        transport fault (reset/refused — never a timeout, which may mean
+        the server acted): safe only for rpcs whose re-execution is
+        harmless (heartbeat, status, store get/exists), and exactly what
+        keeps a replica alive through a lighthouse connection blip."""
         with self._lock:
-            if self._sock is None:
-                self._sock = connect(self._addr, self._connect_timeout)
-            self._sock.settimeout(timeout + self._headroom_s)
-            try:
-                send_frame(self._sock, msg_type, payload)
-                return recv_frame(self._sock)
-            except socket.timeout as e:
-                self._drop_socket()
-                raise TimeoutError(f"rpc 0x{msg_type:x} to {self._addr} timed out") from e
-            except (ConnectionError, OSError, WireError):
-                self._drop_socket()
-                raise
+            attempts = 2 if idempotent else 1
+            for attempt in range(attempts):
+                if self._sock is None:
+                    self._sock = connect(self._addr, self._connect_timeout)
+                self._sock.settimeout(timeout + self._headroom_s)
+                try:
+                    send_frame(self._sock, msg_type, payload)
+                    return recv_frame(self._sock)
+                except socket.timeout as e:
+                    self._drop_socket()
+                    raise TimeoutError(
+                        f"rpc 0x{msg_type:x} to {self._addr} timed out"
+                    ) from e
+                except WireError:
+                    self._drop_socket()
+                    raise
+                except (ConnectionError, OSError):
+                    self._drop_socket()
+                    if attempt + 1 >= attempts:
+                        raise
+            raise AssertionError("unreachable")  # pragma: no cover
 
     def close(self) -> None:
         with self._lock:
