@@ -199,6 +199,20 @@ def _diloco_quant_env() -> str:
     return v if v in ("0", "1") else "auto"
 
 
+def _outer_shard_mode_env() -> str:
+    """Canonical TORCHFT_OUTER_SHARD mode via the SAME parser the workers
+    use (``local_sgd._outer_shard_mode``), so every accepted spelling —
+    'off'/'false' included — labels the artifact the way the fleet actually
+    ran.  An unparseable value falls back to the raw string: it will never
+    equal "0", and the workers crash on it loudly anyway."""
+    from torchft_tpu.local_sgd import _outer_shard_mode
+
+    try:
+        return _outer_shard_mode()
+    except ValueError:
+        return os.environ.get("TORCHFT_OUTER_SHARD", "auto").strip().lower()
+
+
 def _sync(tree: Any) -> None:
     """True device sync: fetch ONE scalar to host.  Under the axon tunnel
     ``jax.block_until_ready`` acknowledges dispatch without waiting for
@@ -1548,6 +1562,9 @@ def main() -> None:
         "kills": faults.get("kills"),
         "diloco_ratio": diloco.get("ratio_per_100step_kill"),
         "diloco_kills": diloco.get("kills_in_sync_window"),
+        # PR-5 trajectory: outer sync cost, sharded vs replicated
+        "sync_overhead_s_sharded": diloco.get("sync_overhead_s_sharded"),
+        "sync_overhead_s_replicated": diloco.get("sync_overhead_s_replicated"),
         "quant_device_reduce": qdr_active,
         "detail": "bench_out.json",
     }
@@ -1690,6 +1707,36 @@ def _run_diloco_phase(
         gate = "forced"
         gate_reason = f"TPUFT_BENCH_DILOCO_QUANT={mode}"
     faultfree = ff_by_wire["quant" if use_quant else "f32"]
+    # sharded-vs-replicated sync overhead (docs/operations.md §11): one
+    # extra fault-free leg pins TORCHFT_OUTER_SHARD=0 (the legacy
+    # replicated outer update) on the chosen wire, so the PR-5 perf
+    # trajectory is machine-readable in the artifact round over round.
+    # Budget-guarded like the quant A/B — the churn run is the phase's
+    # headline and is never starved for this row.
+    budget_left = None if deadline_ts is None else deadline_ts - time.time()
+    if _outer_shard_mode_env() != "0" and (
+        budget_left is None or budget_left >= 360.0
+    ):
+        # when the session itself pins the legacy path the main legs ARE
+        # replicated — an extra pinned leg would be a meaningless A/A burn
+        ff_by_wire["replicated"] = run_fleet(
+            "diloco_faultfree_replicated",
+            target_steps=ff_target,
+            sizes=sizes,
+            worker_platform=worker_platform,
+            replicas=replicas,
+            mode="diloco",
+            extra_env={
+                "TPUFT_BENCH_DILOCO_QUANT_WIRE": "1" if use_quant else "0",
+                "TORCHFT_OUTER_SHARD": "0",
+            },
+            deadline_s=_budget_left(deadline_ts, 0.25, 90.0),
+        )
+        print(
+            f"bench: diloco fault-free [replicated] "
+            f"{ff_by_wire['replicated']}",
+            file=sys.stderr,
+        )
     return _diloco_churn_and_summary(
         sizes, worker_platform, replicas, deadline_ts,
         ff_by_wire, faultfree, use_quant, gate, gate_reason,
@@ -1747,6 +1794,26 @@ def _diloco_churn_and_summary(
     for wire, r in ff_by_wire.items():
         if r.get("sync_overhead_s") is not None:
             out[f"sync_overhead_s_{wire}"] = r["sync_overhead_s"]
+    # the f32/quant legs run with the session's TORCHFT_OUTER_SHARD
+    # (default auto = sharded); the "replicated" leg pinned =0.  Emit the
+    # chosen wire's overhead under a stable "sharded" name next to the
+    # replicated row so BENCH artifacts compare like for like.
+    shard_mode = _outer_shard_mode_env()
+    out["outer_shard_mode"] = shard_mode
+    if faultfree.get("sync_overhead_s") is not None:
+        if shard_mode != "0":
+            out["sync_overhead_s_sharded"] = faultfree["sync_overhead_s"]
+        else:
+            # pinned-legacy session: the chosen wire's leg ran replicated
+            out.setdefault(
+                "sync_overhead_s_replicated", faultfree["sync_overhead_s"]
+            )
+    so_r = out.get("sync_overhead_s_replicated")
+    so_s = out.get("sync_overhead_s_sharded")
+    if so_r is not None and so_s is not None:
+        out["sharded_vs_replicated_sync_overhead"] = round(
+            so_r / max(so_s, 1e-4), 3
+        )
     if "sync_overhead_s_f32" in out and "sync_overhead_s_quant" in out:
         base = max(out["sync_overhead_s_f32"], 1e-4)
         out["quant_vs_f32_sync_overhead"] = round(

@@ -221,6 +221,163 @@ def _striped_rank_main(rank, world, port, mb, iters, gbps, rtt_ms, out_q):
         out_q.put(results)
 
 
+def _diloco_rank_main(rank, world, port, mb, iters, gbps, rtt_ms, out_q):
+    """One DiLoCo outer sync per iteration, replicated vs sharded, f32 and
+    int8 wires: the replicated leg allreduces the full pseudo-gradient and
+    runs the full outer update on every rank (the pre-shard path's shape);
+    the sharded leg runs the chunk-pipelined reduce_scatter → 1/world outer
+    update → allgather(delta).  Both legs produce params from the same
+    seeded pseudo-gradients, asserted allclose in-bench — the speedup
+    column can never ride a silent numeric divergence."""
+    os.environ["TORCHFT_NET_GBPS"] = str(gbps)
+    os.environ["TORCHFT_NET_RTT_MS"] = str(rtt_ms)
+    os.environ.setdefault("TORCHFT_QUANT_DEVICE_REDUCE", "0")
+    import jax
+    import optax
+
+    from torchft_tpu.collectives import (
+        allreduce_quantized,
+        outer_shard_layout,
+        outer_sharded_sync,
+    )
+    from torchft_tpu.communicator import ReduceOp, TCPCommunicator
+
+    comm = TCPCommunicator(timeout_s=300.0)
+    comm.configure(
+        f"127.0.0.1:{port}/diloco_{gbps}_{rtt_ms}",
+        replica_id=f"r{rank}",
+        rank=rank,
+        world_size=world,
+    )
+    n = mb * (1 << 20) // 4
+    tx = optax.sgd(0.7, momentum=0.9, nesterov=True)
+    psg = np.random.default_rng(100 + rank).normal(size=n).astype(np.float32)
+    backup = np.ones(n, dtype=np.float32)
+    results = {}
+    params = {}
+
+    def _slice_state(state, per, lo, hi):
+        return jax.tree_util.tree_map(
+            lambda l: l[lo:hi] if getattr(l, "shape", None) == (per,) else l,
+            state,
+        )
+
+    # long-lived outer state, as the real fragment holds it across syncs
+    # (the replicated path replicates the FULL state; the sharded path
+    # holds 1/world of it — the ZeRO-1 memory claim, visible right here)
+    repl_state = jax.tree_util.tree_map(np.asarray, tx.init(backup))
+    _padded_f, per_f, _u = outer_shard_layout(n, world, False)
+    _padded_q, per_q, _u = outer_shard_layout(n, world, True)
+    shard_state = {
+        False: jax.tree_util.tree_map(
+            np.asarray, tx.init(np.zeros(per_f, dtype=np.float32))
+        ),
+        True: jax.tree_util.tree_map(
+            np.asarray, tx.init(np.zeros(per_q, dtype=np.float32))
+        ),
+    }
+    backup_pad = np.zeros(max(_padded_f, _padded_q), dtype=np.float32)
+    backup_pad[:n] = backup
+
+    def _replicated(quant: bool) -> np.ndarray:
+        if quant:
+            avg = allreduce_quantized(comm, psg.copy()).wait(timeout=300.0)
+        else:
+            avg = comm.allreduce(psg.copy(), ReduceOp.SUM).wait(timeout=300.0)
+        avg = np.asarray(avg, dtype=np.float32) / world
+        updates, _ = tx.update(avg, repl_state, backup)
+        return backup + np.asarray(updates, dtype=np.float32)
+
+    def _sharded(quant: bool) -> np.ndarray:
+        per = per_q if quant else per_f
+        state = shard_state[quant]
+        base = comm.rank() * per
+
+        def _cb(lo, hi, avg):
+            updates, _ = tx.update(
+                avg, _slice_state(state, per, lo - base, hi - base),
+                backup_pad[lo:hi],
+            )
+            return np.asarray(updates, dtype=np.float32)
+
+        delta = outer_sharded_sync(
+            comm, psg, _cb, num_participants=world, should_quantize=quant
+        )
+        return backup + delta
+
+    for quant, wire in ((False, "f32"), (True, "quant")):
+        for label, fn in (("replicated", _replicated), ("sharded", _sharded)):
+            params[f"{label}_{wire}"] = fn(quant)  # warm
+            comm.barrier().wait(timeout=300.0)
+            # median-of-iters: one paused scheduler tick on a shared CI box
+            # would otherwise swing the mean by 30%+
+            dts = []
+            for _ in range(max(iters, 5)):
+                t0 = time.perf_counter()
+                fn(quant)
+                dts.append(time.perf_counter() - t0)
+            comm.barrier().wait(timeout=300.0)
+            results[f"diloco_{label}_{wire}_s"] = sorted(dts)[len(dts) // 2]
+        # in-bench numeric gate: the sharded outer step must land on the
+        # replicated result.  f32 differs only by reduction order; the two
+        # legs quantize at DIFFERENT points (replicated requantizes the
+        # reduced pseudo-grad, sharded quantizes the delta), so the
+        # quantized bound is a few int8 row grids of the ~N(0,1) payload —
+        # far below any real divergence, which would be O(outer lr) ≈ 0.4
+        tol = 0.03 if quant else 1e-4
+        assert np.allclose(
+            params[f"replicated_{wire}"], params[f"sharded_{wire}"],
+            rtol=0.0, atol=tol,
+        ), (
+            f"sharded outer sync diverged from replicated ({wire}): max "
+            f"abs diff "
+            f"{np.max(np.abs(params[f'replicated_{wire}'] - params[f'sharded_{wire}']))}"
+        )
+
+    comm.barrier().wait(timeout=60.0)
+    comm.shutdown()
+    if rank == 0:
+        out_q.put(results)
+
+
+def run_diloco_profile(name, gbps, rtt_ms, mb, iters, world=3):
+    """Sharded-vs-replicated DiLoCo outer-sync rows at ``world`` replicas.
+    The headline ``diloco_sharded_vs_replicated`` is the DEFAULT (f32)
+    wire's speedup; the int8 ratio rides alongside as
+    ``diloco_sharded_vs_replicated_quant`` (docs/operations.md §11)."""
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer("127.0.0.1:0")
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_diloco_rank_main,
+            args=(r, world, store.port, mb, iters, gbps, rtt_ms, out_q),
+        )
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        res = out_q.get(timeout=1800)
+        for p in procs:
+            p.join(timeout=120)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+        store.shutdown()
+    res["diloco_sharded_vs_replicated"] = round(
+        res["diloco_replicated_f32_s"] / res["diloco_sharded_f32_s"], 3
+    )
+    res["diloco_sharded_vs_replicated_quant"] = round(
+        res["diloco_replicated_quant_s"] / res["diloco_sharded_quant_s"], 3
+    )
+    return {k: (round(v, 4) if isinstance(v, float) else v) for k, v in res.items()}
+
+
 def _hier_host_main(proc_idx, hosts, per_host, port, mb, iters, gbps, rtt_ms, out_q):
     """One PROCESS per emulated host, its replicas as THREADS: every rank
     of the host shares the process's emulated NIC (the communicator's
@@ -436,6 +593,8 @@ def main():
                     help="skip the 3-replica striped-heal phase")
     ap.add_argument("--no-hier", action="store_true",
                     help="skip the hierarchical 2-host topology sweep")
+    ap.add_argument("--no-diloco", action="store_true",
+                    help="skip the 3-replica sharded-vs-replicated outer-sync sweep")
     args = ap.parse_args()
 
     rows = []
@@ -452,6 +611,11 @@ def main():
                         name, gbps, rtt, args.mb, args.iters, per_host
                     )
                 )
+        if not args.no_diloco and name.startswith("wan_1g"):
+            # sharded outer optimizer at the DCN profile the feature targets
+            row.update(
+                run_diloco_profile(name, gbps, rtt, args.mb, args.iters)
+            )
         print(json.dumps(row), flush=True)
         rows.append(row)
 
@@ -504,6 +668,23 @@ def main():
                 f"| **{r['allreduce_4lane_speedup']}x** "
                 f"| {flaky} |"
             )
+        print()
+        print(
+            "| profile | outer sync | replicated | sharded (3 replicas) "
+            "| speedup |"
+        )
+        print("|---|---|---|---|---|")
+        for r in rows:
+            if "diloco_sharded_quant_s" not in r:
+                continue
+            for wire in ("f32", "quant"):
+                suffix = "" if wire == "f32" else "_quant"
+                print(
+                    f"| {r['profile']} | {wire} "
+                    f"| {r[f'diloco_replicated_{wire}_s']*1e3:.0f} ms "
+                    f"| {r[f'diloco_sharded_{wire}_s']*1e3:.0f} ms "
+                    f"| **{r[f'diloco_sharded_vs_replicated{suffix}']}x** |"
+                )
         print()
         print(
             "| profile | topology | flat ring | hierarchical | speedup |"

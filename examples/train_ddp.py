@@ -103,6 +103,12 @@ def main() -> None:
         min_replica_size=args.min_replicas,
         replica_id=f"train_ddp_{args.replica_group_id}",
         server_cls=manager_server_cls(tier),
+        # manager RPCs (should_commit vote, checkpoint fetch) must detect a
+        # wedged/dissolved peer on the same clock as the data plane: with
+        # the 60 s default, a replica thawing from a freeze burned a full
+        # minute in a doomed vote against a quorum that no longer existed
+        # while its healthy peer trained to completion and exited
+        timeout=args.comm_timeout,
     )
     opt = OptimizerWrapper(manager, tx)
 

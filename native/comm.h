@@ -425,6 +425,25 @@ class Communicator {
     return parts;
   }
 
+  // deterministic per-replica shard split for the sharded outer optimizer;
+  // identical math to the Python tier (communicator.outer_shard_parts): the
+  // buffer is padded to a multiple of parts*unit and every shard is exactly
+  // padded/parts bytes, so both tiers agree on shard ownership from the
+  // payload size and participant count alone.  `unit` must be a positive
+  // multiple of 64 (64 for raw f32 shards, the quantization row byte size
+  // for int8 shards, so a boundary never splits a row).
+  static std::vector<std::pair<size_t, size_t>> outer_shard_parts(
+      size_t nbytes, size_t parts, size_t unit = 64) {
+    if (parts < 1 || unit < 1 || unit % 64 != 0)
+      throw std::invalid_argument("outer_shard_parts: bad parts/unit");
+    size_t share = (nbytes + parts * unit - 1) / (parts * unit) * unit;
+    std::vector<std::pair<size_t, size_t>> out;
+    out.reserve(parts);
+    for (size_t p = 0; p < parts; ++p)
+      out.emplace_back(p * share, (p + 1) * share);
+    return out;
+  }
+
   int64_t rank() const { return rank_; }
   int64_t size() const { return world_size_; }
   void set_timeout(double t) { timeout_s_ = t; }

@@ -278,9 +278,17 @@ class TestDilocoQuantGate:
     def test_forced_wire_skips_ab(self, monkeypatch):
         out, calls = self._run(monkeypatch, {"quant": 0.2}, env="1")
         labels = [l for (l, _e) in calls]
-        assert labels == ["diloco_faultfree_quant", "diloco_churn"]
+        # forcing the wire skips the f32/quant A/B, but the replicated
+        # outer-sync leg (sharded-vs-replicated trajectory row) still runs
+        assert labels == [
+            "diloco_faultfree_quant",
+            "diloco_faultfree_replicated",
+            "diloco_churn",
+        ]
         assert out["quantized_sync"] is True
         assert out["quant_gate"] == "forced"
+        repl_env = [e for (l, e) in calls if l == "diloco_faultfree_replicated"][0]
+        assert repl_env["TORCHFT_OUTER_SHARD"] == "0"
 
 
 class TestPhaseACaptureGuards:

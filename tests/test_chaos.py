@@ -223,22 +223,43 @@ def test_sigstop_process_wedge_evicts_and_heals(tmp_path) -> None:
     (train loop, manager server, heartbeats).  Peers abort their wedged
     collectives, the lighthouse ages the frozen replica's heartbeat out,
     and training continues; SIGCONT brings it back to rejoin and heal.
-    Final param hashes must agree across all replicas."""
+    Final param hashes must agree across all replicas.
+
+    Root cause of the historical ~50% flake (silent hash divergence):
+    a RACE between the victim's post-thaw recovery and the survivor's
+    remaining runway.  The thawed incarnation's first act is a
+    ``should_commit`` vote against a quorum that dissolved during the
+    freeze; with the Manager's 60 s default RPC timeout (train_ddp.py
+    only wired ``--comm-timeout`` into the *communicator*) that doomed
+    vote burned ~60 s before the process died and the supervisor
+    restarted it.  Meanwhile the survivor trained its remaining ~110
+    solo steps in ~25 s, printed FINAL, and exited — so the restarted
+    victim formed a single-replica quorum at step 0 with NO live peer
+    to heal from and silently retrained from scratch on its own data
+    shard.  Fixed by (a) train_ddp.py passing the comm timeout to the
+    Manager so wedge detection takes seconds, not a minute, and (b)
+    pacing below that keeps the survivor's post-thaw runway several
+    times the worst-case recovery; the rejoin assertion downgrades any
+    recurrence from silent divergence to a named pacing failure."""
     from torchft_tpu.launcher import ReplicaSpec, ReplicaSupervisor
 
     server = LighthouseServer(
         bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=500, quorum_tick_ms=20
     )
-    # paced steps so the healthy replica cannot FINISH during the freeze
-    # even on a fast idle machine (the victim must rejoin a live peer to
-    # heal — that's the scenario): 150 steps x >=0.15s >= 22s >> 12s freeze
+    # paced steps so the healthy replica cannot FINISH before the victim
+    # rejoins (it must heal from a LIVE peer — that's the scenario).  The
+    # budget race: victim recovery after the 12 s freeze costs about
+    # op-timeout (5 s) + vote timeout (5 s, now that train_ddp wires
+    # --comm-timeout into the Manager's RPCs) + restart delay + rejoin
+    # ≈ 15 s worst case; the survivor still owes >= ~110 steps x 0.25 s
+    # ≈ 27 s of paced runway at thaw — ~2x margin even on a loaded box.
     cmd = [
         sys.executable,
         str(REPO / "examples" / "train_ddp.py"),
         "--steps", "150",
         "--platform", "cpu",
         "--comm-timeout", "5",
-        "--step-time", "0.15",
+        "--step-time", "0.25",
     ]
     logs = {i: tmp_path / f"rg{i}.log" for i in range(2)}
     specs = [
@@ -284,10 +305,24 @@ def test_sigstop_process_wedge_evicts_and_heals(tmp_path) -> None:
         time.sleep(3.0)
         # freeze > comm timeout + heartbeat timeout (eviction), auto-thaw
         controller.inject(Failure.DEADLOCK, victim=victim, secs=12.0)
+        # watermark AFTER the SIGSTOP lands: only log bytes appended once
+        # the victim is frozen count as rejoin evidence — a commit line
+        # flushed in the instant before the freeze must not satisfy the
+        # post-thaw assertion (the supervisor opens logs in append mode)
+        frozen_at = logs[1].stat().st_size if logs[1].exists() else 0
         # healed = the victim commits again after the thaw
         assert controller.await_heal(victim, timeout_s=120.0)
         runner.join(timeout=180)
         assert not runner.is_alive(), "fleet did not finish"
+        # the victim must have committed WITH the survivor after the thaw;
+        # solo-only commits mean the survivor finished and exited before
+        # the victim rejoined (the pacing race in the docstring), which
+        # silently retrains the victim from scratch — fail it by name
+        post = logs[1].read_bytes()[frozen_at:].decode(errors="replace")
+        assert re.search(r"committed=True participants=2", post), (
+            "victim never rejoined the live survivor after the thaw — its "
+            "recovery outlasted the survivor's remaining paced runway"
+        )
     finally:
         supervisor.stop()
         server.shutdown()

@@ -1,0 +1,480 @@
+"""Sharded outer optimizer (ZeRO-1 over the replica dim) tests.
+
+The tentpole contract of the sharded outer sync
+(``TORCHFT_OUTER_SHARD``, ``collectives.outer_sharded_sync``,
+``local_sgd._OuterShard``):
+
+- shard boundaries are a pure function of (payload size, owner count) —
+  deterministic, 64-byte / quantization-row aligned, identical on every
+  replica at any world size (mirrored in ``native/comm.h``);
+- the chunk-pipelined reduce_scatter → sharded update → allgather(delta)
+  produces the same result as the replicated path (bit-identical across
+  replicas, allclose to replicated — exactly equal in f32 where the
+  reduction order matches);
+- ``TORCHFT_OUTER_SHARD=0`` is the untouched legacy path (the golden
+  DiLoCo regression fixture pins it; at world size 1 the sharded flat-f32
+  math is bit-identical to it);
+- membership changes reshard: outer state redistributes over an
+  allgather exchange, a healed checkpoint contributes the source's shard,
+  and ranges owned by a dead replica re-initialize without forking params;
+- the hierarchical composition shards per HOST: leaders own state, members
+  ride shm and move zero socket bytes.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu.collectives import (
+    outer_shard_layout,
+    outer_sharded_sync,
+)
+from torchft_tpu.communicator import (
+    DummyCommunicator,
+    TCPCommunicator,
+    outer_shard_parts,
+)
+from torchft_tpu.lighthouse import LighthouseServer
+from torchft_tpu.local_sgd import DiLoCo, _outer_shard_mode, _OuterShard
+from torchft_tpu.manager import Manager
+from torchft_tpu.quantization import DEFAULT_ROW_SIZE
+from torchft_tpu.store import StoreServer
+
+from tests.test_manager import MemoryTransport, StubClient, _quorum_result
+
+
+@pytest.fixture()
+def store():
+    server = StoreServer("127.0.0.1:0")
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture()
+def lighthouse():
+    server = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=2,
+        join_timeout_ms=200,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=1000,
+    )
+    yield server
+    server.shutdown()
+
+
+class TestShardLayout:
+    def test_parts_are_deterministic_aligned_and_equal(self) -> None:
+        for nbytes in (0, 64, 1000, 1 << 20, (1 << 20) + 4):
+            for parts in (1, 2, 3, 5, 8):
+                got = outer_shard_parts(nbytes, parts)
+                assert len(got) == parts
+                share = got[0][1] - got[0][0]
+                assert share % 64 == 0 and share * parts >= nbytes
+                for p, (s, e) in enumerate(got):
+                    assert (s, e) == (p * share, (p + 1) * share)
+                # pure function: same inputs → same split, every time
+                assert got == outer_shard_parts(nbytes, parts)
+
+    def test_quantized_layout_is_row_aligned(self) -> None:
+        for ws in (2, 3, 4):
+            padded, per, unit = outer_shard_layout(123_457, ws, True)
+            assert unit == DEFAULT_ROW_SIZE
+            assert per % DEFAULT_ROW_SIZE == 0 and padded == per * ws
+            padded_f, per_f, unit_f = outer_shard_layout(123_457, ws, False)
+            assert unit_f == 16 and per_f % 16 == 0 and padded_f >= 123_457
+
+    def test_bad_args_are_loud(self) -> None:
+        from torchft_tpu.communicator import CommunicatorError
+
+        with pytest.raises(CommunicatorError):
+            outer_shard_parts(100, 0)
+        with pytest.raises(CommunicatorError):
+            outer_shard_parts(100, 2, unit=63)
+
+    def test_mode_parse_is_loud(self, monkeypatch) -> None:
+        for raw, want in (("", "auto"), ("auto", "auto"), ("1", "1"), ("0", "0")):
+            monkeypatch.setenv("TORCHFT_OUTER_SHARD", raw)
+            assert _outer_shard_mode() == want
+        monkeypatch.setenv("TORCHFT_OUTER_SHARD", "bogus")
+        with pytest.raises(ValueError, match="TORCHFT_OUTER_SHARD"):
+            _outer_shard_mode()
+
+
+def _run_comm_ranks(
+    store: StoreServer,
+    world: int,
+    fn: Callable[[TCPCommunicator, int], object],
+    prefix: str,
+    hosts: Optional[List[str]] = None,
+) -> List[object]:
+    def _one(rank: int) -> object:
+        kwargs = {}
+        if hosts is not None:
+            kwargs = {"host_id": hosts[rank], "hierarchical": "1"}
+        comm = TCPCommunicator(timeout_s=30.0, **kwargs)
+        comm.configure(
+            f"127.0.0.1:{store.port}/{prefix}",
+            replica_id=f"rep_{rank}",
+            rank=rank,
+            world_size=world,
+        )
+        try:
+            return fn(comm, rank)
+        finally:
+            comm.shutdown()
+
+    with ThreadPoolExecutor(max_workers=world) as pool:
+        return list(pool.map(_one, range(world)))
+
+
+def _psg(rank: int, n: int) -> np.ndarray:
+    return np.random.default_rng(100 + rank).normal(size=n).astype(np.float32)
+
+
+class TestShardedPipeline:
+    """collectives-level: the pipeline vs a replicated reference."""
+
+    LR = 0.5
+
+    def _reference(self, world: int, n: int) -> np.ndarray:
+        avg = np.mean([_psg(r, n) for r in range(world)], axis=0)
+        return (-self.LR * avg).astype(np.float32)
+
+    def _sharded(self, comm, rank, n, quant) -> np.ndarray:
+        timings: dict = {}
+        delta = outer_sharded_sync(
+            comm,
+            _psg(rank, n),
+            lambda lo, hi, avg: -self.LR * avg,
+            num_participants=comm.size(),
+            should_quantize=quant,
+            timings=timings,
+        )
+        assert timings["wall_s"] > 0
+        return delta
+
+    @pytest.mark.parametrize("world", [2, 3])
+    def test_flat_f32_matches_replicated(self, store, world) -> None:
+        n = 70_000
+        deltas = _run_comm_ranks(
+            store,
+            world,
+            lambda c, r: self._sharded(c, r, n, False),
+            f"os_f32_{world}",
+        )
+        # bit-identical across replicas: everyone applies the wire delta
+        for d in deltas[1:]:
+            np.testing.assert_array_equal(deltas[0], d)
+        np.testing.assert_allclose(
+            deltas[0], self._reference(world, n), rtol=1e-5, atol=1e-6
+        )
+
+    @pytest.mark.parametrize("world", [2, 3])
+    def test_flat_quantized_matches_replicated(self, store, world) -> None:
+        n = 70_000
+        deltas = _run_comm_ranks(
+            store,
+            world,
+            lambda c, r: self._sharded(c, r, n, True),
+            f"os_q_{world}",
+        )
+        for d in deltas[1:]:
+            np.testing.assert_array_equal(deltas[0], d)
+        ref = self._reference(world, n)
+        # two rowwise int8 passes (pseudo-grad + delta): ~1% of row max
+        tol = 2.5 * np.abs(ref).max() / 127
+        np.testing.assert_allclose(deltas[0], ref, atol=tol)
+
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_hierarchical_matches_replicated(self, store, quant) -> None:
+        # 3 replicas on 2 emulated hosts: leaders (ranks 0, 2) own shards,
+        # the member rides shm and receives the identical delta
+        n = 70_000
+        deltas = _run_comm_ranks(
+            store,
+            3,
+            lambda c, r: self._sharded(c, r, n, quant),
+            f"os_hier_{int(quant)}",
+            hosts=["h0", "h0", "h1"],
+        )
+        for d in deltas[1:]:
+            np.testing.assert_array_equal(deltas[0], d)
+        ref = self._reference(3, n)
+        tol = 2.5 * np.abs(ref).max() / 127 if quant else 1e-5
+        np.testing.assert_allclose(deltas[0], ref, atol=max(tol, 1e-6))
+
+    def test_chunk_pipeline_update_order(self, store, monkeypatch) -> None:
+        """Small chunks → the callback runs once per chunk, in order, over
+        exactly this owner's shard ranges."""
+        monkeypatch.setenv("TORCHFT_OUTER_CHUNK_MB", "0.05")
+        n = 200_000
+
+        def _run(comm, rank):
+            seen: List[tuple] = []
+
+            def _cb(lo, hi, avg):
+                seen.append((lo, hi))
+                return np.zeros(hi - lo, dtype=np.float32)
+
+            outer_sharded_sync(comm, _psg(rank, n), _cb, comm.size())
+            return seen
+
+        results = _run_comm_ranks(store, 2, _run, "os_chunks")
+        padded, per, _unit = outer_shard_layout(n, 2, False)
+        for rank, seen in enumerate(results):
+            assert len(seen) > 1, "expected a multi-chunk pipeline"
+            assert seen[0][0] == rank * per
+            assert seen[-1][1] == rank * per + per
+            for (a0, a1), (b0, b1) in zip(seen, seen[1:]):
+                assert a1 == b0, "chunks must tile the shard in order"
+
+
+def _mock_manager(client, comm=None):
+    return Manager(
+        comm=comm or DummyCommunicator(),
+        load_state_dict=None,
+        state_dict=None,
+        min_replica_size=1,
+        use_async_quorum=False,
+        checkpoint_transport=MemoryTransport(),
+        _manager_client=client,
+        rank=0,
+        world_size=1,
+    )
+
+
+def _trajectory(monkeypatch, mode: str, steps: int = 6) -> np.ndarray:
+    monkeypatch.setenv("TORCHFT_OUTER_SHARD", mode)
+    client = StubClient()
+    for _ in range(steps):
+        client.quorum_results.append(
+            _quorum_result(replica_world_size=1, max_world_size=1)
+        )
+    manager = _mock_manager(client)
+    holder = {
+        "params": {
+            "w1": jnp.arange(300, dtype=jnp.float32),
+            "w2": jnp.full(17, 2.0, dtype=jnp.float32),
+        }
+    }
+    diloco = DiLoCo(
+        manager,
+        holder,
+        optax.sgd(0.7, momentum=0.9, nesterov=True),
+        sync_every=2,
+        fragment_update_alpha=0.25,
+    )
+    for step in range(steps):
+        holder["params"] = jax.tree_util.tree_map(
+            lambda p: p - 0.05 * (1.0 + 0.1 * step), holder["params"]
+        )
+        diloco.step()
+    return np.concatenate(
+        [np.asarray(l).ravel() for l in jax.tree_util.tree_leaves(holder["params"])]
+    )
+
+
+class TestGateBitIdentity:
+    def test_shard0_bit_identical_to_sharded_at_ws1(self, monkeypatch) -> None:
+        """At world size 1 the sharded flat-f32 schedule runs the identical
+        elementwise math as the legacy replicated path — bit-for-bit.  (The
+        legacy path itself is pinned against the pre-PR golden fixture by
+        ``test_local_sgd.py::TestDiLoCoRegression``.)"""
+        legacy = _trajectory(monkeypatch, "0")
+        sharded = _trajectory(monkeypatch, "1")
+        np.testing.assert_array_equal(legacy, sharded)
+
+    def test_sharded_timings_flow_to_quorum_timings(self, monkeypatch) -> None:
+        monkeypatch.setenv("TORCHFT_OUTER_SHARD", "1")
+        client = StubClient()
+        client.quorum_results.append(
+            _quorum_result(replica_world_size=1, max_world_size=1)
+        )
+        manager = _mock_manager(client)
+        holder = {"params": {"w": jnp.full(64, 4.0)}}
+        diloco = DiLoCo(manager, holder, optax.sgd(0.5), sync_every=1)
+        holder["params"] = {"w": holder["params"]["w"] - 1.0}
+        assert diloco.step() is True
+        assert "outer_shard_wall_s" in manager.last_quorum_timings
+        assert "outer_shard_update_s" in manager.last_quorum_timings
+
+
+class TestOuterShardState:
+    def _shard_with_state(self, per_owner_n=64, gsize=2, gidx=0):
+        tx = optax.sgd(0.5, momentum=0.9)
+        n = per_owner_n * gsize
+        shard = _OuterShard(tx, n, should_quantize=False)
+        _padded, per, _unit = outer_shard_layout(n, gsize, False)
+        shard.meta = {
+            "q": 7, "gsize": gsize, "gidx": gidx, "per": per, "n": n,
+            "owns": True,
+        }
+        leaves, treedef = shard._fresh_leaves(per)
+        shard._state_leaves, shard._state_treedef = leaves, treedef
+        return shard, per
+
+    def test_update_cb_stages_until_commit(self) -> None:
+        shard, per = self._shard_with_state()
+        backup = np.ones(per * 2, dtype=np.float32)
+        cb = shard.make_update_cb(backup)
+        avg = np.full(per, 2.0, dtype=np.float32)
+        delta = cb(0, per, avg)
+        # sgd momentum first step: delta = -lr * avg
+        np.testing.assert_allclose(delta, -1.0 * np.full(per, 1.0), atol=1e-6)
+        # trace staged, not live
+        assert float(np.abs(shard._state_leaves[0]).max()) == 0.0
+        shard.commit_stage()
+        assert float(np.abs(shard._state_leaves[0]).max()) > 0.0
+
+    def test_abort_stage_keeps_old_state(self) -> None:
+        shard, per = self._shard_with_state()
+        cb = shard.make_update_cb(np.ones(per * 2, dtype=np.float32))
+        cb(0, per, np.full(per, 2.0, dtype=np.float32))
+        shard.abort_stage()
+        assert float(np.abs(shard._state_leaves[0]).max()) == 0.0
+
+    def test_rebuild_merges_contributions_and_reinits_holes(self) -> None:
+        """3-way layout shrinking to 2-way: surviving shards' momentum
+        carries over elementwise; the dead owner's range re-initializes."""
+        tx = optax.sgd(0.5, momentum=0.9)
+        n = 96
+        _p3, per3, _u = outer_shard_layout(n, 3, False)
+        contribs = []
+        for gidx in (0, 2):  # owner 1 "died"
+            trace = np.full(per3, 10.0 + gidx, dtype=np.float32)
+            meta = {"q": 1, "gsize": 3, "gidx": gidx, "per": per3, "n": n,
+                    "owns": True}
+            contribs.append((meta, [trace]))
+        shard = _OuterShard(tx, n, should_quantize=False)
+        _p2, per2, _u2 = outer_shard_layout(n, 2, False)
+        meta2 = {"q": 2, "gsize": 2, "gidx": 0, "per": per2, "n": n,
+                 "owns": True}
+        shard._rebuild(contribs, meta2)
+        got = shard._state_leaves[0]
+        full = np.zeros(max(3 * per3, 2 * per2), dtype=np.float32)
+        full[0 * per3 : 1 * per3] = 10.0
+        full[2 * per3 : 3 * per3] = 12.0
+        np.testing.assert_array_equal(got, full[:per2])
+
+    def test_save_load_roundtrip_contributes_at_reshard(self) -> None:
+        shard, per = self._shard_with_state()
+        shard._state_leaves[0][:] = 3.5
+        saved = shard.save_state()
+        other = _OuterShard(optax.sgd(0.5, momentum=0.9), per * 2, False)
+        other.load_state(saved)
+        assert other.meta is None  # forces reshard at the next sync
+        meta = {"q": 9, "gsize": 2, "gidx": 0, "per": per, "n": per * 2,
+                "owns": True}
+        other._rebuild(other._export_contribs(), meta)
+        np.testing.assert_array_equal(other._state_leaves[0], 3.5)
+
+
+def _diloco_replica(
+    idx: int,
+    lighthouse_addr: str,
+    num_syncs: int,
+    sync_every: int,
+    stop_after: Optional[int] = None,
+    quant: bool = False,
+) -> dict:
+    comm = TCPCommunicator(timeout_s=10.0)
+    holder = {"params": {"w": jnp.full(4096, 1.0, dtype=jnp.float32)}}
+    manager = Manager(
+        comm=comm,
+        load_state_dict=lambda s: holder.update(s),
+        state_dict=lambda: dict(holder),
+        min_replica_size=2,
+        use_async_quorum=False,
+        replica_id=f"shard_{idx}",
+        lighthouse_addr=lighthouse_addr,
+        timeout=10.0,
+        quorum_timeout=10.0,
+    )
+    diloco = DiLoCo(
+        manager,
+        holder,
+        optax.sgd(0.7, momentum=0.9, nesterov=True),
+        sync_every=sync_every,
+        should_quantize=quant,
+    )
+    syncs = 0
+    try:
+        while syncs < num_syncs:
+            holder["params"] = jax.tree_util.tree_map(
+                lambda p: p - 0.01 * (idx + 1), holder["params"]
+            )
+            result = diloco.step()
+            if result is not None:
+                syncs += 1
+                if stop_after is not None and syncs >= stop_after:
+                    # "die" mid-run: peers' in-flight outer sync fails,
+                    # votes down, and the survivors reshard next quorum
+                    return {"stopped": True}
+        return {
+            "params": np.asarray(holder["params"]["w"]),
+            "timings": dict(manager.last_quorum_timings),
+        }
+    finally:
+        manager.shutdown()
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_diloco_sharded_two_replicas_converge(lighthouse, quant) -> None:
+    """End-to-end DiLoCo over the manager stack with the sharded sync on:
+    replicas with different inner progress end bit-identical, and the
+    sharded timings surface in last_quorum_timings."""
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        futures = [
+            pool.submit(
+                _diloco_replica, i, lighthouse.local_address(), 3, 2,
+                None, quant,
+            )
+            for i in range(2)
+        ]
+        states = [f.result(timeout=120.0) for f in futures]
+    np.testing.assert_array_equal(
+        states[0]["params"], states[1]["params"]
+    )
+    assert states[0]["params"][0] < 1.0  # outer steps actually applied
+    assert "outer_shard_wall_s" in states[0]["timings"]
+
+
+@pytest.mark.slow
+def test_diloco_kill_one_replica_resharded_survivors_converge() -> None:
+    """3 replicas; one dies mid-run.  The survivors' next quorum reshards
+    the outer state 3-ways → 2-ways and syncs keep committing; survivor
+    params stay bit-identical."""
+    server = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=2,
+        join_timeout_ms=200,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=800,
+    )
+    try:
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            futures = [
+                pool.submit(
+                    _diloco_replica,
+                    i,
+                    server.local_address(),
+                    6,
+                    2,
+                    2 if i == 2 else None,
+                )
+                for i in range(3)
+            ]
+            states = [f.result(timeout=180.0) for f in futures]
+    finally:
+        server.shutdown()
+    assert states[2] == {"stopped": True}
+    np.testing.assert_array_equal(states[0]["params"], states[1]["params"])
+    assert states[0]["params"][0] < 1.0

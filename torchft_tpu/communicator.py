@@ -663,6 +663,31 @@ def _lane_parts(
     return [(lane, bounds[lane], bounds[lane + 1]) for lane in range(k)]
 
 
+def outer_shard_parts(
+    nbytes: int, parts: int, unit: int = _STRIPE_ALIGN
+) -> List[Tuple[int, int]]:
+    """Deterministic per-replica shard split for the sharded outer
+    optimizer (``local_sgd``): the buffer is padded up to a multiple of
+    ``parts * unit`` and every shard is exactly ``padded // parts`` bytes.
+    A pure function of the payload size and the participant count — every
+    replica derives identical shard ownership with no extra wire metadata,
+    the same contract as :func:`_lane_parts` — and ``unit``-aligned so a
+    shard boundary never splits an element (64 B default) or a
+    quantization row (callers pass the row byte size).  Mirrored exactly in
+    ``native/comm.h outer_shard_parts`` so the tiers agree on shard
+    ownership at any world size.  Returns ``[(start, stop), ...]`` over the
+    PADDED byte range, one entry per shard."""
+    if parts < 1:
+        raise CommunicatorError("outer_shard_parts: parts must be >= 1")
+    if unit < 1 or unit % _STRIPE_ALIGN != 0:
+        raise CommunicatorError(
+            f"outer_shard_parts: unit must be a positive multiple of "
+            f"{_STRIPE_ALIGN}, got {unit}"
+        )
+    share = -(-nbytes // (parts * unit)) * unit
+    return [(p * share, (p + 1) * share) for p in range(parts)]
+
+
 # ---------------------------------------------------------------------------
 # host topology + shared-memory intra-host transport
 # ---------------------------------------------------------------------------

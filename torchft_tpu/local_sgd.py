@@ -26,7 +26,9 @@ optax.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List, Optional, Union
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax
 import numpy as np
@@ -35,6 +37,235 @@ from torchft_tpu.ddp import allreduce_pytree
 from torchft_tpu.manager import Manager
 
 logger = logging.getLogger(__name__)
+
+# Sharded outer optimizer (ZeRO-1 over the replica dimension):
+#   auto/1 — the outer sync runs as a chunk-pipelined
+#            reduce_scatter → sharded outer update → allgather(delta):
+#            each replica (each HOST on hierarchical topologies) holds only
+#            its shard of the outer optimizer state, updates it the moment
+#            its reduce-scatter chunk lands (while later chunks are still
+#            on the wire), and the updates fan back out as deltas applied
+#            identically everywhere.  Outer compute and optimizer memory
+#            divide by the shard count; membership changes reshard.
+#   0      — the legacy replicated path, byte-for-byte: allreduce the full
+#            pseudo-gradient, every replica runs the identical full outer
+#            update.
+OUTER_SHARD_ENV = "TORCHFT_OUTER_SHARD"
+
+# reshard-exchange collective tags (allgather wire tags 5880/5881 — clear
+# of the sharded pipeline's 900+ chunk tag range and every legacy tag base)
+_RESHARD_LEN_TAG = 880
+_RESHARD_BLOB_TAG = 881
+
+
+def _outer_shard_mode() -> str:
+    raw = os.environ.get(OUTER_SHARD_ENV, "auto").strip().lower()
+    if raw in ("", "auto"):
+        return "auto"
+    if raw in ("1", "true", "on"):
+        return "1"
+    if raw in ("0", "false", "off"):
+        return "0"
+    raise ValueError(f"unparseable {OUTER_SHARD_ENV}={raw!r} (auto|0|1)")
+
+
+class _OuterShard:
+    """This owner's shard of one fragment's outer optimizer state.
+
+    The flat f32 element space of the fragment is split into deterministic
+    equal shards (``collectives.outer_shard_layout``, 64-byte / row aligned,
+    mirrored in ``native/comm.h``); this object holds the optax state for
+    ONE shard as numpy leaves, serves per-chunk slices to the pipelined
+    sync (``update_cb``), stages the updated state until the commit vote,
+    and re-partitions on membership change.
+
+    Resharding: whenever the quorum id moved since the layout was built,
+    every replica contributes its (meta, state-shard) over two allgathers
+    (lengths, then padded pickles) and reassembles the new shard from
+    whichever contributions cover each element range.  Ranges owned by a
+    replica that died are re-initialized fresh (momentum history is the
+    only loss — parameters are replicated everywhere and unaffected); a
+    healed replica contributes the shard it received in the checkpoint, so
+    a kill/rejoin cycle conserves every surviving byte of state."""
+
+    def __init__(self, outer_tx: Any, n: int, should_quantize: bool) -> None:
+        self._outer_tx = outer_tx
+        self._n = n
+        self._quant = should_quantize
+        # (quorum_id, gsize, gidx, per, owns) of the current layout
+        self.meta: Optional[Dict[str, Any]] = None
+        self._state_leaves: Optional[List[Any]] = None
+        self._state_treedef: Optional[Any] = None
+        self._staged: Optional[List[Any]] = None
+        # (meta, leaves) recovered from a healing checkpoint, contributed at
+        # the next reshard (our own rank may differ from the source's)
+        self._loaded: List[Tuple[Dict[str, Any], List[Any]]] = []
+
+    # -- layout ----------------------------------------------------------
+
+    def _fresh_leaves(self, per: int) -> Tuple[List[Any], Any]:
+        state = self._outer_tx.init(np.zeros(per, dtype=np.float32))
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        return [
+            np.array(l, copy=True) if getattr(l, "shape", None) == (per,) else l
+            for l in map(np.asarray, leaves)
+        ], treedef
+
+    def _is_shard_leaf(self, leaf: Any, per: int) -> bool:
+        return getattr(leaf, "shape", None) == (per,)
+
+    def maybe_reshard(self, manager: Manager) -> None:
+        """(Re)build this owner's shard for the current quorum.  Gated on
+        the quorum id alone — a shared fact, so every replica enters (or
+        skips) the collective exchange in lock-step; steady-state syncs
+        skip everything."""
+        qid = manager._quorum_id
+        if self.meta is not None and self.meta["q"] == qid:
+            return
+        from torchft_tpu.collectives import outer_shard_layout
+
+        gsize, gidx, owns = manager.outer_shard_group()
+        _padded, per, unit = outer_shard_layout(self._n, gsize, self._quant)
+        meta = {
+            "q": qid,
+            "gsize": gsize,
+            "gidx": gidx,
+            "per": per,
+            "n": self._n,
+            "owns": owns,
+        }
+        contribs = self._export_contribs()
+        comm = manager._comm
+        if comm.size() > 1 and not getattr(comm, "is_passthrough", False):
+            blob = pickle.dumps(contribs)
+            try:
+                lens = comm.allgather(
+                    np.array([len(blob)], dtype=np.int64), tag=_RESHARD_LEN_TAG
+                ).wait()
+                maxlen = max(int(np.asarray(l).reshape(-1)[0]) for l in lens)
+                padded_blob = np.zeros(max(1, maxlen), dtype=np.uint8)
+                padded_blob[: len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+                blobs = comm.allgather(padded_blob, tag=_RESHARD_BLOB_TAG).wait()
+                contribs = []
+                for l, b in zip(lens, blobs):
+                    size = int(np.asarray(l).reshape(-1)[0])
+                    try:
+                        contribs.extend(pickle.loads(bytes(bytearray(b[:size]))))
+                    except Exception:  # noqa: BLE001 — skip a bad peer blob
+                        logger.warning("outer-shard reshard: bad peer blob")
+            except Exception as e:  # noqa: BLE001 — the sync right after
+                # this will surface comm errors; reshard falls back to the
+                # locally-held contributions (peers' shards re-init fresh)
+                logger.warning("outer-shard reshard exchange failed: %s", e)
+                contribs = self._export_contribs()
+        self._rebuild(contribs, meta)
+
+    def _export_contribs(self) -> List[Tuple[Dict[str, Any], List[Any]]]:
+        out = list(self._loaded)
+        if self.meta is not None and self._state_leaves is not None:
+            out.append((dict(self.meta), self._state_leaves))
+        return out
+
+    def _rebuild(
+        self,
+        contribs: List[Tuple[Dict[str, Any], List[Any]]],
+        meta: Dict[str, Any],
+    ) -> None:
+        self._loaded = []
+        self._staged = None
+        self.meta = meta
+        if not meta["owns"]:
+            self._state_leaves, self._state_treedef = None, None
+            return
+        per = meta["per"]
+        leaves, treedef = self._fresh_leaves(per)
+        my_lo, my_hi = meta["gidx"] * per, meta["gidx"] * per + per
+        for cmeta, cleaves in contribs:
+            if cmeta.get("n") != self._n or not cmeta.get("owns", True):
+                continue
+            cper = cmeta["per"]
+            c_lo = cmeta["gidx"] * cper
+            lo, hi = max(my_lo, c_lo), min(my_hi, c_lo + cper)
+            if lo >= hi or len(cleaves) != len(leaves):
+                continue
+            for j, (mine, theirs) in enumerate(zip(leaves, cleaves)):
+                theirs = np.asarray(theirs)
+                if self._is_shard_leaf(mine, per) and self._is_shard_leaf(
+                    theirs, cper
+                ):
+                    mine[lo - my_lo : hi - my_lo] = theirs[lo - c_lo : hi - c_lo]
+                elif getattr(theirs, "shape", None) == ():
+                    # scalar leaves (step counts): keep the max seen so a
+                    # recovered shard never rewinds schedules
+                    leaves[j] = np.maximum(np.asarray(leaves[j]), theirs)
+        self._state_leaves, self._state_treedef = leaves, treedef
+
+    # -- sync ------------------------------------------------------------
+
+    def make_update_cb(self, backup_flat: np.ndarray):
+        """Per-chunk outer update for the pipelined sync: slices this
+        shard's state, steps the outer optimizer on the chunk, stages the
+        new state (adopted only on commit), returns the delta."""
+        assert self.meta is not None and self.meta["owns"]
+        assert self._state_leaves is not None
+        per = self.meta["per"]
+        base = self.meta["gidx"] * per
+        old = self._state_leaves
+        treedef = self._state_treedef
+        self._staged = [
+            np.array(l, copy=True) if self._is_shard_leaf(l, per) else l
+            for l in old
+        ]
+        staged = self._staged
+        tx = self._outer_tx
+
+        def _cb(lo: int, hi: int, avg: np.ndarray) -> np.ndarray:
+            s, e = lo - base, hi - base
+            # chunks slice the ORIGINAL state (scalar leaves update from
+            # the same pre-sync value on every chunk — consistent)
+            state_slice = jax.tree_util.tree_unflatten(
+                treedef,
+                [l[s:e] if self._is_shard_leaf(l, per) else l for l in old],
+            )
+            updates, new_state = tx.update(
+                avg, state_slice, backup_flat[lo:hi]
+            )
+            for j, leaf in enumerate(jax.tree_util.tree_leaves(new_state)):
+                leaf = np.asarray(leaf)
+                if self._is_shard_leaf(staged[j], per):
+                    staged[j][s:e] = leaf
+                else:
+                    staged[j] = leaf
+            return np.asarray(updates, dtype=np.float32)
+
+        return _cb
+
+    def commit_stage(self) -> None:
+        if self._staged is not None:
+            self._state_leaves = self._staged
+        self._staged = None
+
+    def abort_stage(self) -> None:
+        self._staged = None
+
+    # -- checkpoint round trip -------------------------------------------
+
+    def save_state(self) -> Optional[Dict[str, Any]]:
+        if self.meta is None:
+            return None
+        return {
+            "meta": dict(self.meta),
+            "leaves": self._state_leaves,
+        }
+
+    def load_state(self, state: Optional[Dict[str, Any]]) -> None:
+        """A healed checkpoint carries the SOURCE's shard; hold it as a
+        reshard contribution (the heal always rides a quorum change, so
+        the next sync reshards and routes every range to its new owner)."""
+        if not state or state.get("leaves") is None:
+            return
+        self._loaded.append((state["meta"], state["leaves"]))
+        self.meta = None  # force reshard at the next sync
 
 
 def _like_leaf(value: np.ndarray, ref: Any) -> Any:
@@ -152,10 +383,35 @@ class _Fragment:
         self._should_quantize = should_quantize
         self._alpha = fragment_update_alpha
         self._work = None
+        self._sharded_inflight = False
 
-        backup = self._current_local()
+        # cache the pytree layout once: the treedef (reused for every
+        # unflatten), and this fragment's per-leaf (shape, dtype, flat
+        # offset) over its f32 element space — sync rounds re-read leaf
+        # VALUES via tree_leaves but never re-derive structure
+        leaves, self._treedef = jax.tree_util.tree_flatten(holder["params"])
+        backup = [np.asarray(leaves[i]) for i in self._leaf_idxs]
         self.backup: List[np.ndarray] = [np.array(a, copy=True) for a in backup]
-        self.outer_state = outer_tx.init(self.backup)
+        self._leaf_meta: List[Tuple[int, int, tuple, Any]] = []
+        off = 0
+        for a in backup:
+            self._leaf_meta.append((off, a.size, a.shape, a.dtype))
+            off += a.size
+        self._n = off
+        # padded f32 scratch for pseudo-gradient / backup assembly, reused
+        # across sync rounds (grown once to the sharded layout's padded
+        # size; the same trick _allreduce_pipelined_sync uses)
+        self._psg_scratch: Optional[np.ndarray] = None
+        self._backup_scratch: Optional[np.ndarray] = None
+
+        # full replicated outer state exists ONLY on the legacy path — in
+        # sharded mode each owner's slice lives in _OuterShard and this
+        # stays None (the ZeRO-1 memory division), allocated lazily if a
+        # sync ever runs with TORCHFT_OUTER_SHARD=0
+        self.outer_state = (
+            outer_tx.init(self.backup) if _outer_shard_mode() == "0" else None
+        )
+        self._shard = _OuterShard(outer_tx, self._n, should_quantize)
 
         # fragment state rides the healing checkpoint
         # (``local_sgd.py:255-286``)
@@ -163,11 +419,16 @@ class _Fragment:
         manager.register_state_dict_fn(key, self._load_state, self._save_state)
 
     def _save_state(self) -> Dict[str, Any]:
-        return {"backup": self.backup, "outer_state": self.outer_state}
+        return {
+            "backup": self.backup,
+            "outer_state": self.outer_state,
+            "outer_shard": self._shard.save_state(),
+        }
 
     def _load_state(self, state: Dict[str, Any]) -> None:
         self.backup = [np.asarray(a) for a in state["backup"]]
-        self.outer_state = state["outer_state"]
+        self.outer_state = state.get("outer_state")
+        self._shard.load_state(state.get("outer_shard"))
 
     def _current_local(self) -> List[np.ndarray]:
         leaves = jax.tree_util.tree_leaves(self._holder["params"])
@@ -176,54 +437,136 @@ class _Fragment:
     def save_parameters(self) -> None:
         self.backup = [np.array(a, copy=True) for a in self._current_local()]
 
+    def _sharded(self) -> bool:
+        return _outer_shard_mode() != "0"
+
+    def _scratch(self, padded: int) -> Tuple[np.ndarray, np.ndarray]:
+        if self._psg_scratch is None or self._psg_scratch.size < padded:
+            self._psg_scratch = np.zeros(padded, dtype=np.float32)
+            self._backup_scratch = np.zeros(padded, dtype=np.float32)
+        assert self._backup_scratch is not None
+        return self._psg_scratch[:padded], self._backup_scratch[:padded]
+
     def prepare_sync(self) -> None:
         """pseudogradient = backup − local, then async average
         (``local_sgd.py:401-420``)."""
         local = self._current_local()
-        pseudograds = [b - l for b, l in zip(self.backup, local)]
         assert self._work is None, "fragment already has an allreduce in flight"
+        if self._sharded():
+            self._prepare_sync_sharded(local)
+            return
+        pseudograds = [b - l for b, l in zip(self.backup, local)]
         # in_place: pseudograds are freshly computed for this call and only
         # the returned average is read afterwards
         self._work = self._manager.allreduce(
             pseudograds, should_quantize=self._should_quantize, in_place=True
         )
 
+    def _prepare_sync_sharded(self, local: List[np.ndarray]) -> None:
+        """Sharded outer sync: assemble the flat pseudo-gradient, (re)build
+        this owner's shard for the current quorum, and hand the per-chunk
+        outer update to the pipelined reduce_scatter→update→allgather."""
+        from torchft_tpu.collectives import outer_shard_layout
+
+        self._shard.maybe_reshard(self._manager)
+        meta = self._shard.meta
+        gsize = meta["gsize"] if meta is not None else 1
+        padded, _per, _unit = outer_shard_layout(
+            self._n, max(1, gsize), self._should_quantize
+        )
+        psg, backup_flat = self._scratch(padded)
+        for (off, size, _shape, _dtype), b, l in zip(
+            self._leaf_meta, self.backup, local
+        ):
+            seg = backup_flat[off : off + size]
+            seg[:] = b.reshape(-1)
+            p = psg[off : off + size]
+            p[:] = seg
+            p -= l.reshape(-1)
+        psg[self._n :] = 0.0
+        backup_flat[self._n :] = 0.0
+
+        update_cb = (
+            self._shard.make_update_cb(backup_flat)
+            if meta is not None and meta["owns"]
+            else _no_shard_cb
+        )
+        self._sharded_inflight = True
+        self._work = self._manager.outer_shard_allreduce(
+            psg[: self._n], update_cb, should_quantize=self._should_quantize
+        )
+
     def perform_sync(self) -> bool:
-        """Wait for the averaged pseudogradients, vote, and apply the outer
-        step (``local_sgd.py:422-475``)."""
+        """Wait for the result, vote, and apply the outer step
+        (``local_sgd.py:422-475``)."""
         assert self._work is not None, "prepare_sync must run first"
-        averaged = self._work.wait()
+        result = self._work.wait()
         self._work = None
+        sharded = self._sharded_inflight
+        self._sharded_inflight = False
 
         local = self._current_local()
         committed = self._manager.should_commit()
 
-        leaves, treedef = jax.tree_util.tree_flatten(self._holder["params"])
-        if committed:
+        leaves = jax.tree_util.tree_leaves(self._holder["params"])
+        if committed and sharded and result is not None:
+            # delta = the allgathered sharded outer update, identical bytes
+            # on every replica: global = backup + delta
+            delta = result
+            global_params = []
+            for (off, size, shape, dtype), b in zip(self._leaf_meta, self.backup):
+                g = (
+                    b.reshape(-1).astype(np.float32) + delta[off : off + size]
+                ).astype(dtype, copy=False).reshape(shape)
+                global_params.append(g)
+            self._apply_global(leaves, global_params, local)
+            self._shard.commit_stage()
+        elif committed and not sharded:
             import optax
 
+            averaged = result
+            if self.outer_state is None:
+                self.outer_state = self._outer_tx.init(self.backup)
             updates, self.outer_state = self._outer_tx.update(
                 averaged, self.outer_state, self.backup
             )
             global_params = optax.apply_updates(self.backup, updates)
             global_params = [np.asarray(g) for g in global_params]
-            # model = (1−α)·global + α·local (``local_sgd.py:366-384``)
-            for j, i in enumerate(self._leaf_idxs):
-                mixed = (
-                    global_params[j]
-                    if self._alpha == 0.0
-                    else (1.0 - self._alpha) * global_params[j]
-                    + self._alpha * local[j]
-                ).astype(local[j].dtype)
-                leaves[i] = _like_leaf(mixed, leaves[i])
-            self.backup = global_params
+            self._apply_global(leaves, global_params, local)
         else:
             # failed sync: reset to the last globally-consistent state so we
             # never overtrain on unsynced data (``local_sgd.py:785-790``)
+            if sharded:
+                self._shard.abort_stage()
             for j, i in enumerate(self._leaf_idxs):
                 leaves[i] = _like_leaf(self.backup[j], leaves[i])
-        self._holder["params"] = jax.tree_util.tree_unflatten(treedef, leaves)
+        self._holder["params"] = jax.tree_util.tree_unflatten(
+            self._treedef, leaves
+        )
         return committed
+
+    def _apply_global(
+        self,
+        leaves: List[Any],
+        global_params: List[np.ndarray],
+        local: List[np.ndarray],
+    ) -> None:
+        """model = (1−α)·global + α·local (``local_sgd.py:366-384``)."""
+        for j, i in enumerate(self._leaf_idxs):
+            mixed = (
+                global_params[j]
+                if self._alpha == 0.0
+                else (1.0 - self._alpha) * global_params[j]
+                + self._alpha * local[j]
+            ).astype(local[j].dtype)
+            leaves[i] = _like_leaf(mixed, leaves[i])
+        self.backup = global_params
+
+
+def _no_shard_cb(lo: int, hi: int, avg: np.ndarray) -> np.ndarray:
+    raise AssertionError(
+        "outer update callback invoked on a replica that owns no shard"
+    )
 
 
 class DiLoCo:

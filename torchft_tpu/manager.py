@@ -211,6 +211,9 @@ class Manager:
         self._quorum_future: Optional[concurrent.futures.Future] = None
         # phase wall-times of the most recent quorum round (see _async_quorum)
         self.last_quorum_timings: Dict[str, float] = {}
+        # pipeline timings of the most recent sharded outer sync; ride the
+        # next quorum-change event into torchft_quorums (outer_shard_*)
+        self._outer_shard_stats: Dict[str, float] = {}
         self._participating_replica_rank: Optional[int] = None
         self._participating_replica_world_size: int = 0
 
@@ -529,6 +532,13 @@ class Manager:
                 "quorum_id": quorum_id,
                 "step": max_step,
             }
+            if self._outer_shard_stats:
+                # sharded-outer-sync pipeline timings of the outgoing epoch
+                # (scatter/update/gather + overlap ratio) ride the same
+                # event, then reset so an epoch with no sharded sync never
+                # re-reports a stale overlap_ratio
+                quorum_extra.update(self._outer_shard_stats)
+                self._outer_shard_stats = {}
             lane_stats_fn = getattr(self._comm, "lane_stats", None)
             prev_lane_stats = lane_stats_fn() if callable(lane_stats_fn) else {}
             if prev_lane_stats:
@@ -932,6 +942,89 @@ class Manager:
 
         threading.Thread(
             target=_run, name="tpuft_prequantized_allreduce", daemon=True
+        ).start()
+        out = Work(fut)
+        self._register_pending(out)
+        return out
+
+    def outer_shard_group(self) -> tuple:
+        """``(group_size, group_index, owns_shard)`` for the sharded outer
+        optimizer under the CURRENT quorum: flat topologies shard across the
+        communicator world (one shard per replica); hierarchical topologies
+        shard across HOSTS (owners are the host leaders — members ride the
+        shared-memory hops and own no outer state).  Callers must hold a
+        completed quorum (``wait_quorum``) — the fragment sync path does."""
+        comm = self._comm
+        topo_fn = getattr(comm, "hier_topology", None)
+        topo = topo_fn() if callable(topo_fn) else None
+        if topo:
+            ring = list(topo["leader_ring"])
+            if topo["is_leader"]:
+                return len(ring), ring.index(comm.rank()), True
+            return len(ring), -1, False
+        ws = max(1, comm.size())
+        return ws, comm.rank() if ws > 1 else 0, True
+
+    def outer_shard_allreduce(
+        self,
+        flat: np.ndarray,
+        update_cb: Callable[[int, int, np.ndarray], np.ndarray],
+        should_quantize: bool = False,
+    ) -> Work:
+        """Fault-tolerant sharded outer sync (ZeRO-1 over the replica dim):
+        chunk-pipelined ``reduce_scatter → update_cb → allgather`` of the
+        flat f32 pseudo-gradient, normalized by ``num_participants()``.
+
+        Same orchestration contract as :meth:`allreduce`: waits the quorum,
+        zeroes the contribution of non-participants (they still run the
+        collective schedule and apply the same deltas, so params never
+        fork), funnels errors into a failed vote, and returns a pending
+        Work.  The value is the f32 delta (``params = backup + delta``) —
+        or ``None`` after any error, which the caller must treat as a
+        discarded step (the vote will be False).  Pipeline phase timings
+        land in ``last_quorum_timings`` as ``outer_shard_*``."""
+        if self.errored():
+            return DummyWork(None)
+        try:
+            self.wait_quorum()
+        except Exception as e:  # noqa: BLE001 — funnel, never raise
+            self.report_error(e)
+            return DummyWork(None)
+        num_participants = self.num_participants()
+        if not self.is_participating():
+            flat = np.zeros_like(flat)
+
+        from torchft_tpu.collectives import outer_sharded_sync
+        from torchft_tpu.quantization import quant_kind
+
+        kind = quant_kind() if should_quantize else None
+        timings = self.last_quorum_timings
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _run() -> None:
+            tm: Dict[str, float] = {}
+            try:
+                delta = outer_sharded_sync(
+                    self._comm,
+                    flat,
+                    update_cb,
+                    num_participants,
+                    should_quantize=should_quantize,
+                    kind=kind or "int8",
+                    timings=tm,
+                )
+                fut.set_result(delta)
+            except Exception as e:  # noqa: BLE001 — funnel, never raise
+                self.report_error(e)
+                fut.set_result(None)
+            finally:
+                if tm:
+                    stats = {f"outer_shard_{k}": v for k, v in tm.items()}
+                    timings.update(stats)
+                    self._outer_shard_stats = stats
+
+        threading.Thread(
+            target=_run, name="tpuft_outer_shard_sync", daemon=True
         ).start()
         out = Work(fut)
         self._register_pending(out)

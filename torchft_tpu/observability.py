@@ -67,6 +67,14 @@ _ATTR_KEYS = (
     "comm_topo_hosts",
     "comm_topo_local_world",
     "comm_shm_bytes",
+    # sharded-outer-sync pipeline timings (torchft_quorums; most recent
+    # DiLoCo sharded sync of the outgoing epoch — scatter/update/gather
+    # wall shares and how much of the outer update the pipeline hid)
+    "outer_shard_scatter_s",
+    "outer_shard_update_s",
+    "outer_shard_gather_s",
+    "outer_shard_wall_s",
+    "outer_shard_overlap_ratio",
     # heal-path counters (torchft_heals; striped checkpoint recovery)
     "heal_bytes",
     "heal_duration_s",
