@@ -1169,6 +1169,58 @@ def _run_single_mode(sizes: Dict[str, int], remat_mode: str) -> Dict[str, Any]:
     return out
 
 
+def _headline_heal_keys(faults: Dict[str, Any]) -> Dict[str, Any]:
+    """Lift the aggregated ``heal_breakdown`` phases into top-level
+    headline keys (respawn / join / transfer / first-commit, plus the
+    standby promote phase) so the spare-promotion gate is comparable
+    round-over-round without digging into bench_out.json.  A key is None
+    when no kill exercised that phase this round (cold respawns have no
+    promote_s, standby promotions no respawn_s)."""
+    bd = faults.get("heal_breakdown") or {}
+    return {
+        "heal_respawn_s": bd.get("respawn_s"),
+        "heal_join_s": bd.get("quorum_wait_s"),
+        "heal_transfer_s": bd.get("quorum_heal_recv_s"),
+        "heal_first_commit_s": bd.get("join_to_first_commit_s"),
+        "heal_promote_s": bd.get("promote_s"),
+    }
+
+
+def _run_spare_phase(num_replicas: int = 3, steps: int = 10) -> Dict[str, Any]:
+    """Hot-spare promotion gate: the thread-plane spare drill (3 actives +
+    1 continuously-warmed spare, one active killed) under the ``wan_1g``
+    profile.  Reports ``mean_heal_in_s`` via promotion, to sit side by
+    side with the process fleet's cold/standby heal-in — the PR-6 payoff
+    (<1 s vs 6–12 s) measured in one artifact."""
+    from torchft_tpu.drill import gray_failure_drill
+
+    saved = {k: os.environ.get(k) for k in ("TORCHFT_NET_EMU",)}
+    os.environ["TORCHFT_NET_EMU"] = "wan_1g"
+    try:
+        report = gray_failure_drill(
+            mode="spare_promote", num_replicas=num_replicas, steps=steps
+        )
+        return {
+            "profile": "wan_1g",
+            "replicas": num_replicas,
+            "spares": 1,
+            "mean_heal_in_s": report["mean_heal_in_s"],
+            "promotion_latency_s": report["promotion_latency_s"],
+            "warm_lag_steps": report["warm_lag_steps"],
+            "quorum_reconfigs": report["quorum_reconfigs"],
+            "promotions_total": report["promotions_total"],
+        }
+    except Exception as e:  # noqa: BLE001 — a failed drill is a recorded
+        # fact, never a lost artifact
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 _PARTIAL: Dict[str, Any] = {}
 # overridable so a recovery subprocess (see _try_tpu_phase_a) never
 # clobbers the parent run's streaming artifact
@@ -1494,6 +1546,19 @@ def main() -> None:
                 }
             _emit_partial(diloco=diloco)
 
+        if not os.environ.get("TPUFT_BENCH_SKIP_SPARE"):
+            # hot-spare promotion gate (thread plane, wan_1g): cheap —
+            # seconds, not minutes — so it only needs a token budget floor
+            if remaining_s() > 30.0:
+                spare_promotion = _run_spare_phase()
+            else:
+                spare_promotion = {
+                    "skipped": f"budget exhausted ({remaining_s():.0f}s left)"
+                }
+            print(f"bench: spare promotion {spare_promotion}", file=sys.stderr)
+            _emit_partial(spare_promotion=spare_promotion)
+            faults["spare_promotion"] = spare_promotion
+
     if ratio is None:
         # fleet phases unusable: fall back to the ws=1 protocol ratio so the
         # bench always reports something honest
@@ -1559,6 +1624,17 @@ def main() -> None:
         "heal_in_s_by_path": (faults.get("faulted_fleet") or {}).get(
             "heal_in_s_by_path"
         ),
+        # heal_breakdown phases as top-level keys (round-over-round
+        # comparable without opening bench_out.json), and the hot-spare
+        # promotion heal-in NEXT TO the cold fleet heal-in — the PR-6
+        # payoff measured side by side
+        **_headline_heal_keys(faults),
+        "spare_mean_heal_in_s": (faults.get("spare_promotion") or {}).get(
+            "mean_heal_in_s"
+        ),
+        "spare_warm_lag_steps": (faults.get("spare_promotion") or {}).get(
+            "warm_lag_steps"
+        ),
         "kills": faults.get("kills"),
         "diloco_ratio": diloco.get("ratio_per_100step_kill"),
         "diloco_kills": diloco.get("kills_in_sync_window"),
@@ -1581,7 +1657,18 @@ def main() -> None:
         headline["remat"] = single_tpu.get("remat")
     blob = json.dumps(headline)
     if len(blob) > 1900:  # belt-and-braces: never outgrow a tail capture
-        for k in ("heal_in_s_by_path", "remat", "ws1_ratio", "tier"):
+        for k in (
+            "heal_in_s_by_path",
+            "remat",
+            "ws1_ratio",
+            "tier",
+            "heal_respawn_s",
+            "heal_join_s",
+            "heal_transfer_s",
+            "heal_first_commit_s",
+            "heal_promote_s",
+            "spare_warm_lag_steps",
+        ):
             headline.pop(k, None)
         blob = json.dumps(headline)
     print(blob)

@@ -207,6 +207,43 @@ class TestFleetMetricsAggregation:
         assert res["heal_in_s_by_path"] == {"cold": 6.0, "standby": 2.0}
 
 
+class TestHeadlineHealKeys:
+    """Round-6: the aggregated ``heal_breakdown`` phases surface as
+    top-level headline keys (respawn / join / transfer / first-commit /
+    promote) so the spare-promotion gate is comparable round-over-round
+    without opening bench_out.json."""
+
+    def test_lifts_phases_to_top_level(self):
+        faults = {
+            "heal_breakdown": {
+                "respawn_s": 1.5,
+                "quorum_wait_s": 2.0,
+                "quorum_heal_recv_s": 3.0,
+                "join_to_first_commit_s": 0.5,
+                "promote_s": 0.3,
+                "all_sane": True,
+            }
+        }
+        keys = bench._headline_heal_keys(faults)
+        assert keys == {
+            "heal_respawn_s": 1.5,
+            "heal_join_s": 2.0,
+            "heal_transfer_s": 3.0,
+            "heal_first_commit_s": 0.5,
+            "heal_promote_s": 0.3,
+        }
+
+    def test_missing_phases_are_none_not_absent(self):
+        """A phase no kill exercised this round must still be a key (None)
+        so round-over-round diffs never mistake 'absent' for 'zero'."""
+        keys = bench._headline_heal_keys({"heal_breakdown": {"respawn_s": 2.0}})
+        assert keys["heal_respawn_s"] == 2.0
+        assert keys["heal_promote_s"] is None
+        assert keys["heal_transfer_s"] is None
+        # no breakdown at all (fleet phase skipped): every key present, None
+        assert all(v is None for v in bench._headline_heal_keys({}).values())
+
+
 class TestDilocoQuantGate:
     """The measured A/B gate for the DiLoCo pseudogradient wire (round-5
     verdict item 4): both wires recorded, churn uses the measured winner,

@@ -1,0 +1,997 @@
+"""Hot-spare tests: wire v3 SPARE role, lighthouse spare registry /
+promotion / quorum-floor math, the warm channels (chunk-watermarked
+snapshot fetches + outer-delta feed), the SpareAgent promotion handshake,
+and the lighthouse-restart re-registration path.
+
+The design under test (ISSUE 6, PHOENIX-style hot swap): a spare pre-joins
+the control plane but never counts toward ``min_replicas`` or the
+anti-split-brain majority; it stays warm on two channels and is promoted
+by the lighthouse in the same quorum computation that would have shrunk
+the fleet — so an active replica's death costs a membership edit, not a
+6–12 s cold heal-in.  A dying or stale spare must never stall or poison
+the active fleet.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchft_tpu.lighthouse import (
+    LighthouseConfig,
+    LighthouseServer,
+    _MemberDetails,
+    _State,
+    quorum_compute,
+)
+from torchft_tpu.manager_server import (
+    ManagerClient,
+    ManagerServer,
+    compute_quorum_results,
+)
+from torchft_tpu.wire import (
+    ROLE_ACTIVE,
+    ROLE_SPARE,
+    ManagerQuorumResult,
+    Quorum,
+    QuorumMember,
+    Reader,
+    WireError,
+    Writer,
+)
+
+
+def _member(i: int, step: int = 0, role: int = ROLE_ACTIVE) -> QuorumMember:
+    return QuorumMember(
+        replica_id=f"replica_{i}",
+        address=f"addr_{i}",
+        store_address=f"store_addr_{i}",
+        step=step,
+        world_size=1,
+        role=role,
+    )
+
+
+# ---------------------------------------------------------------------------
+# wire v3
+# ---------------------------------------------------------------------------
+
+
+class TestWireV3:
+    def test_quorum_spare_tail_roundtrip(self) -> None:
+        q = Quorum(
+            quorum_id=7,
+            participants=[_member(0), _member(1)],
+            created=1.5,
+            spares=[_member(9, step=3)],
+        )
+        w = Writer()
+        q.encode(w)
+        out = Quorum.decode(Reader(w.payload()))
+        assert [m.replica_id for m in out.participants] == [
+            "replica_0",
+            "replica_1",
+        ]
+        assert [m.replica_id for m in out.spares] == ["replica_9"]
+        assert all(s.role == ROLE_SPARE for s in out.spares)
+        assert all(p.role == ROLE_ACTIVE for p in out.participants)
+
+    def test_spare_free_quorum_byte_identical_to_v2(self, monkeypatch) -> None:
+        """A spare-free fleet must stay byte-for-byte on the v2 layout —
+        rolling upgrades never see new bytes until a spare registers."""
+        q = Quorum(quorum_id=1, participants=[_member(0)], created=2.0)
+        w3 = Writer()
+        q.encode(w3)
+        monkeypatch.setenv("TORCHFT_WIRE_COMPAT", "2")
+        w2 = Writer()
+        q.encode(w2)
+        assert w3.payload() == w2.payload()
+
+    def test_quorum_spare_tail_suppressed_under_compat(self, monkeypatch) -> None:
+        monkeypatch.setenv("TORCHFT_WIRE_COMPAT", "2")
+        q = Quorum(
+            quorum_id=1,
+            participants=[_member(0)],
+            spares=[_member(9)],
+        )
+        w = Writer()
+        q.encode(w)
+        out = Quorum.decode(Reader(w.payload()))
+        assert out.spares == []  # v2 wire: the tail is never emitted
+
+    def test_result_spare_tail_roundtrip(self) -> None:
+        r = ManagerQuorumResult(
+            quorum_id=3,
+            replica_rank=-1,
+            replica_world_size=2,
+            store_address="s",
+            max_step=11,
+            max_replica_rank=None,
+            max_world_size=2,
+            heal=False,
+            replica_ids=["a", "b"],
+            is_spare=True,
+            spare_replica_ids=["sp_0"],
+            all_manager_addresses=["a:1", "b:2"],
+        )
+        w = Writer()
+        r.encode(w)
+        out = ManagerQuorumResult.decode(Reader(w.payload()))
+        assert out.is_spare is True
+        assert out.spare_replica_ids == ["sp_0"]
+        assert out.all_manager_addresses == ["a:1", "b:2"]
+        assert out.max_step == 11
+
+    def test_result_spare_free_byte_identical_to_v2(self, monkeypatch) -> None:
+        r = ManagerQuorumResult(
+            quorum_id=3,
+            replica_rank=0,
+            replica_world_size=1,
+            store_address="s",
+            max_step=4,
+            max_replica_rank=0,
+            max_world_size=1,
+            heal=False,
+            replica_ids=["a"],
+        )
+        w3 = Writer()
+        r.encode(w3)
+        monkeypatch.setenv("TORCHFT_WIRE_COMPAT", "2")
+        w2 = Writer()
+        r.encode(w2)
+        assert w3.payload() == w2.payload()
+        out = ManagerQuorumResult.decode(Reader(w3.payload()))
+        assert out.is_spare is False and out.spare_replica_ids == []
+
+
+# ---------------------------------------------------------------------------
+# compute_quorum_results: the spare view
+# ---------------------------------------------------------------------------
+
+
+class TestSpareQuorumResults:
+    def _quorum(self) -> Quorum:
+        return Quorum(
+            quorum_id=5,
+            participants=[_member(0, step=7), _member(1, step=7)],
+            spares=[_member(9, step=5, role=ROLE_SPARE)],
+        )
+
+    def test_spare_view(self) -> None:
+        res = compute_quorum_results("replica_9", 0, self._quorum(), True)
+        assert res.is_spare is True
+        assert res.replica_rank == -1
+        assert res.heal is False  # a spare warms, it never heals in-band
+        assert res.max_step == 7
+        assert res.replica_ids == ["replica_0", "replica_1"]
+        assert res.all_manager_addresses == ["addr_0", "addr_1"]
+        assert res.spare_replica_ids == ["replica_9"]
+
+    def test_active_view_carries_spare_facts(self) -> None:
+        res = compute_quorum_results("replica_0", 0, self._quorum(), True)
+        assert res.is_spare is False
+        assert res.spare_replica_ids == ["replica_9"]
+        assert res.all_manager_addresses == ["addr_0", "addr_1"]
+        assert not res.heal
+
+    def test_unknown_replica_still_raises(self) -> None:
+        with pytest.raises(WireError):
+            compute_quorum_results("replica_3", 0, self._quorum(), True)
+
+
+# ---------------------------------------------------------------------------
+# lighthouse quorum math: floors, majority, promotion (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(min_replicas: int, hb_ms: int = 1000) -> LighthouseConfig:
+    return LighthouseConfig(
+        min_replicas=min_replicas,
+        bind="127.0.0.1:0",
+        join_timeout_ms=0,
+        quorum_tick_ms=10,
+        heartbeat_timeout_ms=hb_ms,
+    )
+
+
+def _register(
+    state: _State, member: QuorumMember, now: float, spare: bool = False
+) -> None:
+    state.heartbeats[member.replica_id] = now
+    if spare:
+        state.spares[member.replica_id] = _MemberDetails(
+            joined=now, member=member
+        )
+        state.spare_ids.add(member.replica_id)
+    else:
+        state.participants[member.replica_id] = _MemberDetails(
+            joined=now, member=member
+        )
+
+
+class TestQuorumFloor:
+    def test_spare_never_counts_toward_min_replicas(self) -> None:
+        now = 100.0
+        state = _State()
+        _register(state, _member(0), now)
+        _register(state, _member(1), now)
+        _register(state, _member(9, role=ROLE_SPARE), now, spare=True)
+        quorum, reason = quorum_compute(now, state, _cfg(min_replicas=3))
+        assert quorum is None, reason
+        assert "need min_replicas 3" in reason
+
+    def test_spare_never_inflates_the_majority_denominator(self) -> None:
+        """1 registered active of 1 healthy active + 1 heartbeating spare:
+        if the spare counted as a healthy replica, 1 <= 2//2 would block
+        the quorum (anti split-brain)."""
+        now = 100.0
+        state = _State()
+        _register(state, _member(0), now)
+        _register(state, _member(9, role=ROLE_SPARE), now, spare=True)
+        quorum, reason = quorum_compute(now, state, _cfg(min_replicas=1))
+        assert quorum is not None, reason
+        assert [m.replica_id for m in quorum] == ["replica_0"]
+
+    def test_eviction_never_digs_below_floor_even_with_a_spare(
+        self, monkeypatch
+    ) -> None:
+        """TORCHFT_EVICT_SLOW must not treat a registered (possibly stale)
+        spare as eviction headroom: with min_replicas at the active count,
+        a flagged straggler stays."""
+        from torchft_tpu.lighthouse import _ReplicaHealth
+
+        monkeypatch.setenv("TORCHFT_EVICT_SLOW", "1")
+        now = 100.0
+        state = _State()
+        for i in range(3):
+            _register(state, _member(i), now)
+        _register(state, _member(9, step=0, role=ROLE_SPARE), now, spare=True)
+        flagged = _ReplicaHealth()
+        flagged.flagged = True
+        state.health["replica_2"] = flagged
+        quorum, reason = quorum_compute(now, state, _cfg(min_replicas=3))
+        assert quorum is not None, reason
+        assert [m.replica_id for m in quorum] == [
+            "replica_0",
+            "replica_1",
+            "replica_2",
+        ]
+        assert state.evicted_now == []
+
+
+class TestPromotion:
+    def _dead_member_state(self, now: float) -> _State:
+        """Prev quorum of 3; replica_2 stopped heartbeating long ago;
+        survivors re-registered; one spare is warm and fresh."""
+        state = _State()
+        prev = [_member(0, step=10), _member(1, step=10), _member(2, step=10)]
+        state.prev_quorum = Quorum(quorum_id=4, participants=prev)
+        _register(state, _member(0, step=10), now)
+        _register(state, _member(1, step=10), now)
+        state.heartbeats["replica_2"] = now - 999.0  # dead
+        _register(state, _member(9, step=9, role=ROLE_SPARE), now, spare=True)
+        return state
+
+    def test_promotes_spare_in_place_of_dead_member(self) -> None:
+        now = 100.0
+        state = self._dead_member_state(now)
+        quorum, reason = quorum_compute(now, state, _cfg(min_replicas=2))
+        assert quorum is not None, reason
+        assert [m.replica_id for m in quorum] == [
+            "replica_0",
+            "replica_1",
+            "replica_9",
+        ]
+        assert state.promoted_now == ["replica_9"]
+        assert state.promotions_total == 1
+        assert "replica_9" in state.promoted
+        assert "replica_9" not in state.spares
+
+    def test_promotes_freshest_spare_first(self) -> None:
+        now = 100.0
+        state = self._dead_member_state(now)
+        # a second, staler spare must lose the tie to the warm one
+        stale = QuorumMember(
+            replica_id="replica_8",
+            address="addr_8",
+            store_address="store_8",
+            step=2,
+            world_size=1,
+            role=ROLE_SPARE,
+        )
+        _register(state, stale, now, spare=True)
+        quorum, reason = quorum_compute(now, state, _cfg(min_replicas=2))
+        assert quorum is not None
+        assert state.promoted_now == ["replica_9"]
+        assert "replica_8" in state.spares  # still parked warm
+
+    def test_fast_path_requires_the_promoted_pin(self, lighthouse) -> None:
+        """A relaunched crash victim registering as role=spare under its
+        old replica_id also matches prev_quorum.participants — it must
+        PARK as an ordinary warming spare, never be handed the standing
+        quorum (it would join collectives on fresh state).  Only the
+        ``promoted`` pin unlocks the fast-path."""
+        from torchft_tpu.lighthouse import LighthouseClient
+
+        import time as _time
+
+        ghost = _member(0, step=5)
+        with lighthouse._lock:
+            lighthouse._state.prev_quorum = Quorum(
+                quorum_id=3, participants=[ghost, _member(1, step=5)]
+            )
+            # replica_1 stays heartbeat-fresh: nobody is dead, so no
+            # LEGITIMATE promotion can fire — isolating the fast-path
+            lighthouse._state.heartbeats["replica_1"] = _time.monotonic() + 3600
+        client = LighthouseClient(
+            lighthouse.local_address(), connect_timeout=5.0
+        )
+        try:
+            with pytest.raises((TimeoutError, WireError, OSError)):
+                # no promoted pin: parks (and times out) instead of being
+                # handed the stale standing quorum
+                client.quorum(
+                    replica_id="replica_0",
+                    timeout=0.4,
+                    address="addr_0",
+                    store_address="store_addr_0",
+                    step=0,
+                    world_size=1,
+                    role=ROLE_SPARE,
+                )
+            with lighthouse._lock:
+                lighthouse._state.promoted.add("replica_0")
+            quorum = client.quorum(
+                replica_id="replica_0",
+                timeout=5.0,
+                address="addr_0",
+                store_address="store_addr_0",
+                step=5,
+                world_size=1,
+                role=ROLE_SPARE,
+            )
+            assert quorum.quorum_id == 3  # the standing quorum, instantly
+        finally:
+            client.close()
+
+    def test_one_death_burns_exactly_one_spare_across_ticks(self) -> None:
+        """dead_prev is recomputed from the unchanged prev_quorum on every
+        tick while the replacement quorum is still forming: the second tick
+        must NOT promote a second spare for the same dead member (the
+        replacement quorum would grow past the old world size)."""
+        now = 100.0
+        state = self._dead_member_state(now)
+        second = QuorumMember(
+            replica_id="replica_8",
+            address="addr_8",
+            store_address="store_8",
+            step=8,
+            world_size=1,
+            role=ROLE_SPARE,
+        )
+        _register(state, second, now, spare=True)
+        quorum_compute(now, state, _cfg(min_replicas=2))
+        assert state.promoted_now == ["replica_9"]
+        # next tick, quorum not yet issued (participants unchanged)
+        quorum, _ = quorum_compute(now + 0.05, state, _cfg(min_replicas=2))
+        assert state.promoted_now == []
+        assert "replica_8" in state.spares  # still parked warm
+        assert state.promotions_total == 1
+        assert quorum is not None and len(quorum) == 3  # never grows to 4
+
+    def test_spare_liveness_bound_is_laxer_than_death_detection(self) -> None:
+        """A spare whose beat is one scheduler hiccup stale (between 1x and
+        3x heartbeat_timeout) must STILL be eligible — a missed promotion
+        is permanent once the shrunk quorum becomes prev — while a spare
+        beyond the 3x bound (probably dead) must not be."""
+        from torchft_tpu.lighthouse import _SPARE_FRESH_FACTOR
+
+        now = 100.0
+        hb_s = 1.0  # _cfg default hb_ms=1000
+        state = self._dead_member_state(now)
+        state.heartbeats["replica_9"] = now - 2.0 * hb_s  # jittery, alive
+        quorum, _ = quorum_compute(now, state, _cfg(min_replicas=2))
+        assert quorum is not None
+        assert state.promoted_now == ["replica_9"]
+
+        state = self._dead_member_state(now)
+        state.heartbeats["replica_9"] = now - (
+            _SPARE_FRESH_FACTOR * hb_s + 0.1
+        )  # probably dead
+        quorum, _ = quorum_compute(now, state, _cfg(min_replicas=2))
+        assert quorum is not None
+        assert state.promoted_now == []
+        assert [m.replica_id for m in quorum] == ["replica_0", "replica_1"]
+
+    def test_max_lag_gate_refuses_a_too_cold_spare(self, monkeypatch) -> None:
+        monkeypatch.setenv("TORCHFT_SPARE_MAX_LAG", "3")
+        now = 100.0
+        state = self._dead_member_state(now)
+        state.spares["replica_9"].member.step = 1  # lag 9 > 3
+        quorum, reason = quorum_compute(now, state, _cfg(min_replicas=2))
+        assert quorum is not None, reason
+        assert state.promoted_now == []
+        assert [m.replica_id for m in quorum] == ["replica_0", "replica_1"]
+
+    def test_promote_disabled_by_env(self, monkeypatch) -> None:
+        monkeypatch.setenv("TORCHFT_SPARE_PROMOTE", "0")
+        now = 100.0
+        state = self._dead_member_state(now)
+        quorum, _ = quorum_compute(now, state, _cfg(min_replicas=2))
+        assert quorum is not None
+        assert state.promoted_now == []
+        assert state.promotions_total == 0
+
+    def test_status_path_never_mutates(self) -> None:
+        now = 100.0
+        state = self._dead_member_state(now)
+        quorum, _ = quorum_compute(
+            now, state, _cfg(min_replicas=2), allow_promote=False
+        )
+        assert quorum is not None
+        assert state.promotions_total == 0
+        assert "replica_9" in state.spares
+
+    def test_shrink_only_round_never_promotes(self) -> None:
+        now = 100.0
+        state = self._dead_member_state(now)
+        state.participants["replica_0"].member.shrink_only = True
+        quorum, _ = quorum_compute(now, state, _cfg(min_replicas=2))
+        assert quorum is not None
+        assert state.promoted_now == []
+        assert [m.replica_id for m in quorum] == ["replica_0", "replica_1"]
+
+    def test_hold_the_shrink_while_heartbeat_verdict_pending(self) -> None:
+        """A freshly-dead member still has a fresh heartbeat: the shrink
+        must be HELD while a warm spare is registered (else the shrunk
+        quorum becomes prev and promotion can never fire), and must
+        proceed once the hold window expires."""
+        now = 100.0
+        state = self._dead_member_state(now)
+        # replica_2's heartbeat is fresh, but it never re-registered
+        state.heartbeats["replica_2"] = now - 0.1
+        cfg = _cfg(min_replicas=2, hb_ms=1000)
+        quorum, reason = quorum_compute(now, state, cfg)
+        assert quorum is None
+        assert "Holding shrink" in reason
+        # window (join 0ms + hb 1000ms from first_joined) expired: shed it
+        late = now + 1.5
+        state.heartbeats["replica_0"] = late
+        state.heartbeats["replica_1"] = late
+        state.heartbeats["replica_9"] = late
+        state.heartbeats["replica_2"] = late - 0.1  # STILL beating (wedged)
+        quorum, reason = quorum_compute(late, state, cfg)
+        assert quorum is not None, reason
+        assert [m.replica_id for m in quorum] == ["replica_0", "replica_1"]
+
+    def test_hold_anchors_on_the_missing_member_not_the_survivors(
+        self,
+    ) -> None:
+        """The flake-hunt scenario: survivors have been parked far longer
+        than the hold window when the victim dies.  Anchoring the window
+        on first_joined would expire it instantly — the shrink issues
+        while the victim's heartbeat is still fresh, and promotion is
+        permanently missed once the shrunk quorum becomes prev.  The
+        window must run from the MEMBER's first observed absence."""
+        now = 100.0
+        state = self._dead_member_state(now)
+        for rid in ("replica_0", "replica_1"):
+            state.participants[rid].joined = now - 10.0  # parked for ages
+        state.heartbeats["replica_2"] = now - 0.1  # just died, still fresh
+        cfg = _cfg(min_replicas=2)
+        quorum, reason = quorum_compute(now, state, cfg)
+        assert quorum is None
+        assert "Holding shrink" in reason
+        # the heartbeat verdict lands: promotion in the same computation
+        state.heartbeats["replica_2"] = now - 10.0
+        quorum, reason = quorum_compute(now + 0.5, state, cfg)
+        assert quorum is not None, reason
+        assert state.promoted_now == ["replica_9"]
+        assert sorted(m.replica_id for m in quorum) == [
+            "replica_0",
+            "replica_1",
+            "replica_9",
+        ]
+
+    def test_no_hold_without_a_spare(self) -> None:
+        now = 100.0
+        state = self._dead_member_state(now)
+        state.heartbeats["replica_2"] = now - 0.1  # fresh but absent
+        state.spares.clear()
+        state.spare_ids.clear()
+        del state.heartbeats["replica_9"]  # the spare is gone entirely
+        quorum, reason = quorum_compute(now, state, _cfg(min_replicas=2))
+        assert quorum is not None, reason
+        assert [m.replica_id for m in quorum] == ["replica_0", "replica_1"]
+
+
+# ---------------------------------------------------------------------------
+# warm channels: chunk-watermarked snapshot + outer-delta feed
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def lighthouse():
+    server = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=1,
+        join_timeout_ms=100,
+        quorum_tick_ms=10,
+    )
+    yield server
+    server.shutdown()
+
+
+class TestWarmChannels:
+    def _server(self, lighthouse, warm_fn=None) -> ManagerServer:
+        return ManagerServer(
+            replica_id="warm_src",
+            lighthouse_addr=lighthouse.local_address(),
+            hostname="127.0.0.1",
+            bind="127.0.0.1:0",
+            store_addr="store_warm_src",
+            world_size=1,
+            warm_fn=warm_fn,
+        )
+
+    def test_warm_index_and_full_fetch_roundtrip(
+        self, lighthouse, monkeypatch
+    ) -> None:
+        from torchft_tpu.checkpointing.serialization import plan_pytree
+        from torchft_tpu.spare import WarmChunkStore
+
+        monkeypatch.setenv("TORCHFT_HEAL_CHUNK_MB", "0.0625")  # 64 KiB chunks
+        state = {
+            "user": {
+                "default": {
+                    "a": np.arange(50_000, dtype=np.float32),
+                    "b": np.ones(30_000, dtype=np.float32),
+                }
+            },
+            "torchft": {"step": 5, "batches_committed": 5},
+        }
+        staged = [(5, plan_pytree(state, snapshot=True))]
+        server = self._server(lighthouse, warm_fn=lambda: staged[0])
+        try:
+            client = ManagerClient(f"127.0.0.1:{server.port}")
+            index = client.warm_index()
+            assert index["step"] == 5
+            assert len(index["chunk_hashes"]) > 2  # genuinely chunked
+            store = WarmChunkStore()
+            got = store.refresh(client, deadline=time.monotonic() + 30.0)
+            assert got is not None
+            step, loaded = got
+            assert step == 5
+            np.testing.assert_array_equal(
+                loaded["user"]["default"]["a"], state["user"]["default"]["a"]
+            )
+            assert loaded["torchft"]["step"] == 5
+            fetched_once = store.chunks_fetched
+
+            # second pass against the SAME staging: every watermark
+            # matches — zero chunks move
+            got = store.refresh(client, deadline=time.monotonic() + 30.0)
+            assert got is not None and store.chunks_fetched == fetched_once
+
+            # move ONE leaf and restage: only its chunks are re-fetched
+            state["user"]["default"]["b"] = np.full(
+                30_000, 2.0, dtype=np.float32
+            )
+            state["torchft"]["step"] = 6
+            staged[0] = (6, plan_pytree(state, snapshot=True))
+            got = store.refresh(client, deadline=time.monotonic() + 30.0)
+            assert got is not None and got[0] == 6
+            np.testing.assert_array_equal(
+                got[1]["user"]["default"]["b"], state["user"]["default"]["b"]
+            )
+            refetched = store.chunks_fetched - fetched_once
+            assert 0 < refetched < len(index["chunk_hashes"]), (
+                "watermark diff must fetch only the moved leaf's chunks"
+            )
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_warm_range_refuses_a_moved_snapshot(self, lighthouse) -> None:
+        from torchft_tpu.checkpointing.serialization import plan_pytree
+
+        staged = [(5, plan_pytree({"x": np.ones(8, np.float32)}))]
+        server = self._server(lighthouse, warm_fn=lambda: staged[0])
+        try:
+            client = ManagerClient(f"127.0.0.1:{server.port}")
+            index = client.warm_index()
+            staged[0] = (6, plan_pytree({"x": np.ones(8, np.float32)}))
+            with pytest.raises(WireError):
+                client.warm_range(index["step"], 0, 8)
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_warm_index_not_found_when_nothing_staged(self, lighthouse) -> None:
+        server = self._server(lighthouse, warm_fn=lambda: None)
+        try:
+            client = ManagerClient(f"127.0.0.1:{server.port}")
+            with pytest.raises(WireError):
+                client.warm_index()
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_delta_feed_cursor_and_ring_bound(
+        self, lighthouse, monkeypatch
+    ) -> None:
+        monkeypatch.setenv("TORCHFT_SPARE_DELTA_BUF_MB", "1")
+        server = self._server(lighthouse)
+        try:
+            client = ManagerClient(f"127.0.0.1:{server.port}")
+            server.publish_delta(1, 0, b"a" * 10)
+            server.publish_delta(2, 0, b"b" * 10)
+            server.publish_delta(2, 1, b"c" * 10)
+            got = client.deltas(1, 0)
+            assert [(s, f) for s, f, _ in got] == [(2, 0), (2, 1)]
+            assert got[0][2] == b"b" * 10
+            assert client.deltas(2, 1) == []
+            # the ring is bounded: a slow spare can never grow an active's
+            # memory — old entries fall off
+            for step in range(3, 3 + 80):
+                server.publish_delta(step, 0, b"x" * 65536)
+            got = client.deltas(0, 0)
+            assert len(got) <= 64
+            assert got[0][0] > 2  # the early entries were evicted
+            client.close()
+        finally:
+            server.shutdown()
+
+
+class TestDeltaSubscription:
+    """Warm channel (a): the SpareAgent's delta cursor must apply entries
+    in order and DEMOTE the shadow on any gap (feed ring overran it) —
+    never apply a delta chain with a hole."""
+
+    def _agent(self, lighthouse, server):
+        from torchft_tpu.communicator import DummyCommunicator
+        from torchft_tpu.manager import Manager
+        from torchft_tpu.spare import SpareAgent
+
+        applied = []
+
+        manager = Manager(
+            comm=DummyCommunicator(),
+            load_state_dict=None,
+            state_dict=None,
+            min_replica_size=1,
+            role="spare",
+            _manager_client=object(),  # mocked control plane
+        )
+        agent = SpareAgent(
+            manager, delta_apply=lambda s, f, p: applied.append((s, f, p))
+        )
+        agent._addresses = [f"127.0.0.1:{server.port}"]
+        agent._loaded_once = True
+        agent._shadow_fresh = True
+        agent.warm_step = 1
+        agent._delta_cursor = (1, 1 << 60)
+        return agent, applied
+
+    def test_applies_in_order_and_advances_warm_step(self, lighthouse) -> None:
+        server = ManagerServer(
+            replica_id="delta_src",
+            lighthouse_addr=lighthouse.local_address(),
+            hostname="127.0.0.1",
+            bind="127.0.0.1:0",
+            store_addr="s",
+            world_size=1,
+        )
+        try:
+            agent, applied = self._agent(lighthouse, server)
+            server.publish_delta(2, 0, b"d2")
+            server.publish_delta(3, 0, b"d3")
+            agent._poll_deltas()
+            assert applied == [(2, 0, b"d2"), (3, 0, b"d3")]
+            assert agent.warm_step == 3
+            assert agent._shadow_fresh
+            agent.close()
+        finally:
+            server.shutdown()
+
+    def test_gap_demotes_the_shadow(self, lighthouse) -> None:
+        server = ManagerServer(
+            replica_id="delta_src2",
+            lighthouse_addr=lighthouse.local_address(),
+            hostname="127.0.0.1",
+            bind="127.0.0.1:0",
+            store_addr="s",
+            world_size=1,
+        )
+        try:
+            agent, applied = self._agent(lighthouse, server)
+            server.publish_delta(4, 0, b"d4")  # hole: steps 2-3 missing
+            agent._poll_deltas()
+            assert applied == []
+            assert not agent._shadow_fresh  # chunk store must re-converge
+            assert agent.warm_step == 1
+            agent.close()
+        finally:
+            server.shutdown()
+
+    def test_oversized_delta_refused_at_publish(self, lighthouse) -> None:
+        """An entry that can never ride a wire frame must be refused at
+        publish — serving it would fail the spare's recv on EVERY poll
+        (the cursor never advancing), permanently killing the feed."""
+        from torchft_tpu.manager_server import _WARM_RANGE_MAX_BYTES
+
+        server = ManagerServer(
+            replica_id="delta_src3",
+            lighthouse_addr=lighthouse.local_address(),
+            hostname="127.0.0.1",
+            bind="127.0.0.1:0",
+            store_addr="s",
+            world_size=1,
+        )
+        try:
+            big = b"\0" * (_WARM_RANGE_MAX_BYTES + 1)
+            server.publish_delta(2, 0, big)
+            assert server._deltas == []  # refused, not enqueued
+            server.publish_delta(3, 0, b"d3")  # feed still works after
+            agent, applied = self._agent(lighthouse, server)
+            agent._poll_deltas()
+            # step 3 arrives as a GAP (step 2 was dropped): the shadow
+            # demotes — exactly the chunk-store fallback the refusal
+            # docstring promises — rather than wedging on a bad frame
+            assert applied == []
+            assert not agent._shadow_fresh
+            agent.close()
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# lighthouse restart (satellite 1): re-register instead of wedging
+# ---------------------------------------------------------------------------
+
+
+class TestLighthouseRestart:
+    def test_fleet_rides_out_a_lighthouse_bounce(self) -> None:
+        """Bounce the thread-plane lighthouse mid-run: the heartbeat loop
+        detects the restart (a beat succeeding after failures), interrupts
+        the parked quorum RPC, and re-registers against the fresh
+        incarnation — commits resume well inside the 60 s quorum timeout
+        that the legacy path would have burned."""
+        from torchft_tpu.communicator import DummyCommunicator
+        from torchft_tpu.manager import Manager
+
+        lighthouse = LighthouseServer(
+            bind="127.0.0.1:0",
+            min_replicas=1,
+            join_timeout_ms=100,
+            quorum_tick_ms=10,
+            heartbeat_timeout_ms=2_000,
+        )
+        port = lighthouse.port
+        addr = lighthouse.local_address()
+        manager = Manager(
+            comm=DummyCommunicator(),
+            load_state_dict=None,
+            state_dict=None,
+            min_replica_size=1,
+            replica_id="bounce_0",
+            lighthouse_addr=addr,
+            timeout=60.0,
+            quorum_timeout=60.0,
+            connect_timeout=5.0,
+            heartbeat_interval=0.05,
+            use_async_quorum=False,
+        )
+        commits = [0]
+        stop = threading.Event()
+
+        def loop() -> None:
+            while not stop.is_set():
+                try:
+                    manager.start_quorum()
+                    if manager.should_commit():
+                        commits[0] += 1
+                except Exception:  # noqa: BLE001 — a bounced round
+                    pass
+                time.sleep(0.02)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        new_lighthouse = None
+        try:
+            deadline = time.monotonic() + 30.0
+            while commits[0] < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert commits[0] >= 3, "fleet never started committing"
+            lighthouse.shutdown()
+            time.sleep(0.3)  # manager's parked rpc is now against a corpse
+            new_lighthouse = LighthouseServer(
+                bind=f"127.0.0.1:{port}",
+                min_replicas=1,
+                join_timeout_ms=100,
+                quorum_tick_ms=10,
+                heartbeat_timeout_ms=2_000,
+            )
+            before = commits[0]
+            deadline = time.monotonic() + 20.0
+            while commits[0] < before + 3 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert commits[0] >= before + 3, (
+                "fleet wedged after the lighthouse restart "
+                f"(commits stuck at {commits[0]})"
+            )
+        finally:
+            stop.set()
+            t.join(timeout=10.0)
+            manager.shutdown()
+            if new_lighthouse is not None:
+                new_lighthouse.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# drills: promotion end-to-end, kill-the-spare-mid-warm
+# ---------------------------------------------------------------------------
+
+
+class TestSpareDrills:
+    def test_spare_promote_drill_loopback(self) -> None:
+        from torchft_tpu.drill import gray_failure_drill
+
+        report = gray_failure_drill(
+            mode="spare_promote", num_replicas=2, steps=8
+        )
+        assert report["promotions_total"] >= 1
+        assert report["quorum_reconfigs"] == 1
+        assert report["promotion_latency_s"] > 0
+
+    def test_kill_spare_drill_loopback(self) -> None:
+        from torchft_tpu.drill import gray_failure_drill
+
+        report = gray_failure_drill(mode="kill_spare", num_replicas=2, steps=8)
+        assert report["quorum_reconfigs"] == 0
+        assert report["promotions_total"] == 0
+
+    @pytest.mark.slow
+    def test_spare_promote_drill_wan_1g_gate(self, monkeypatch) -> None:
+        """The ISSUE 6 acceptance gate: 3 replicas + 1 spare under wan_1g,
+        killing an active yields sub-second heal-in via promotion."""
+        from torchft_tpu.drill import gray_failure_drill
+
+        monkeypatch.setenv("TORCHFT_NET_EMU", "wan_1g")
+        report = gray_failure_drill(
+            mode="spare_promote", num_replicas=3, steps=10
+        )
+        assert report["promotions_total"] >= 1
+        assert report["quorum_reconfigs"] == 1
+        assert report["mean_heal_in_s"] < 1.0, report
+
+    @pytest.mark.slow
+    def test_kill_spare_drill_wan_1g_flaky(self, monkeypatch) -> None:
+        """Kill-the-spare-mid-warm under a shaped flaky link: zero quorum
+        reconfigurations and bit-identical fleet params (asserted inside
+        the drill)."""
+        from torchft_tpu.drill import gray_failure_drill
+
+        monkeypatch.setenv("TORCHFT_NET_EMU", "wan_1g")
+        report = gray_failure_drill(mode="kill_spare", num_replicas=3, steps=10)
+        assert report["quorum_reconfigs"] == 0
+
+
+# ---------------------------------------------------------------------------
+# guards
+# ---------------------------------------------------------------------------
+
+
+class TestRoleGuards:
+    def test_manager_rejects_unknown_role(self) -> None:
+        from torchft_tpu.communicator import DummyCommunicator
+        from torchft_tpu.manager import Manager
+
+        with pytest.raises(ValueError, match="role"):
+            Manager(
+                comm=DummyCommunicator(),
+                load_state_dict=None,
+                state_dict=None,
+                min_replica_size=1,
+                role="observer",
+            )
+
+    def test_cpp_manager_server_refuses_spare_role(self) -> None:
+        from torchft_tpu.native import CppManagerServer
+
+        with pytest.raises(ValueError, match="SPARE"):
+            CppManagerServer(
+                replica_id="x",
+                lighthouse_addr="127.0.0.1:1",
+                hostname="h",
+                bind="127.0.0.1:0",
+                store_addr="s",
+                world_size=1,
+                role=ROLE_SPARE,
+            )
+
+    def test_warm_staging_rate_limited_before_first_landing(
+        self, monkeypatch
+    ) -> None:
+        """The refresh interval must hold even while nothing is staged yet
+        (first copy still queued, or staging failing): without that, every
+        round queues another full-model copy on the quorum executor."""
+        from torchft_tpu.communicator import DummyCommunicator
+        from torchft_tpu.manager import Manager
+
+        monkeypatch.setenv("TORCHFT_SPARE_WARM_REFRESH_S", "30")
+        manager = Manager(
+            comm=DummyCommunicator(),
+            load_state_dict=None,
+            state_dict=None,
+            min_replica_size=1,
+            _manager_client=object(),
+        )
+        submits = []
+        manager._manager_server = object()  # advertise a server
+        manager._spare_replica_ids = ["spare_x"]
+        monkeypatch.setattr(
+            manager,
+            "_executor",
+            type(
+                "E", (), {"submit": lambda self, fn, *a: submits.append(fn)}
+            )(),
+        )
+        manager._maybe_stage_warm()  # first round submits
+        manager._maybe_stage_warm()  # _warm_staged still None: must NOT
+        manager._maybe_stage_warm()
+        assert len(submits) == 1
+
+    def test_spare_role_refused_under_pinned_wire_compat(
+        self, monkeypatch, lighthouse
+    ) -> None:
+        """TORCHFT_WIRE_COMPAT<3 must REFUSE a spare, not silently
+        register it as a full active (which would count toward
+        min_replicas/majority and train on a cold shadow)."""
+        from torchft_tpu.communicator import DummyCommunicator
+        from torchft_tpu.lighthouse import LighthouseClient
+        from torchft_tpu.manager import Manager
+
+        monkeypatch.setenv("TORCHFT_WIRE_COMPAT", "2")
+        with pytest.raises(ValueError, match="wire v3"):
+            Manager(
+                comm=DummyCommunicator(),
+                load_state_dict=None,
+                state_dict=None,
+                min_replica_size=1,
+                role="spare",
+                _manager_client=object(),
+            )
+        client = LighthouseClient(
+            lighthouse.local_address(), connect_timeout=5.0
+        )
+        try:
+            with pytest.raises(ValueError, match="wire v3"):
+                client.quorum(
+                    replica_id="x",
+                    timeout=0.1,
+                    address="a",
+                    store_address="s",
+                    step=0,
+                    world_size=1,
+                    role=ROLE_SPARE,
+                )
+        finally:
+            client.close()
+
+    def test_spare_agent_requires_spare_manager(self) -> None:
+        from torchft_tpu.communicator import DummyCommunicator
+        from torchft_tpu.manager import Manager
+        from torchft_tpu.spare import SpareAgent
+
+        manager = Manager(
+            comm=DummyCommunicator(),
+            load_state_dict=None,
+            state_dict=None,
+            min_replica_size=1,
+            _manager_client=object(),  # mocked control plane: no sockets
+        )
+        with pytest.raises(ValueError, match="spare"):
+            SpareAgent(manager)

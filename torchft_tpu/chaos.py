@@ -62,6 +62,10 @@ class Failure(enum.Enum):
     PARTITION = "partition"  # the victim is cut from the fleet (data-plane
     # partition mask + paused heartbeats): the majority side must form a
     # quorum without it (anti split-brain keeps the minority down)
+    SPARE = "spare"  # kill a WARMING hot spare (wire-v3 SPARE role): the
+    # active fleet must not notice — zero quorum reconfigurations, no
+    # stalls, no poisoned state (a spare never counts toward membership
+    # and its warm RPCs are served outside the heal path)
 
 
 @dataclass
@@ -180,6 +184,11 @@ class ThreadReplica(ReplicaHandle):
             return getattr(self._obj, "heal_transport", None) is not None
         if failure is Failure.HOST_LEADER:
             return self._is_host_leader()
+        if failure is Failure.SPARE:
+            # only a replica currently in the SPARE role qualifies (a
+            # promoted spare is an active — killing it is Failure.KILL)
+            manager = getattr(self._obj, "manager", None)
+            return getattr(manager, "role", "active") == "spare"
         if failure in _GRAY_DEFAULT_SPECS:
             comm = getattr(self._obj, "comm", None)
             return callable(getattr(comm, "arm_faults", None))
@@ -207,6 +216,12 @@ class ThreadReplica(ReplicaHandle):
                 )
             self._obj.kill_flag.set()
         elif failure is Failure.KILL:
+            self._obj.kill_flag.set()
+        elif failure is Failure.SPARE:
+            if getattr(getattr(self._obj, "manager", None), "role", None) != "spare":
+                raise RuntimeError(
+                    f"{self.name}: not a spare in the current epoch"
+                )
             self._obj.kill_flag.set()
         elif failure is Failure.DEADLOCK:
             self._obj.wedge_secs = float(kw.get("secs", 10.0))

@@ -459,6 +459,7 @@ def outer_sharded_sync(
     kind: str = INT8,
     row_size: int = DEFAULT_ROW_SIZE,
     timings: Optional[dict] = None,
+    tap: Optional[Callable[[np.ndarray], None]] = None,
 ) -> np.ndarray:
     """ZeRO-1-style sharded outer sync: chunk-pipelined
     ``reduce_scatter → sharded outer update → allgather(update)``.
@@ -495,6 +496,12 @@ def outer_sharded_sync(
     ``params = backup + delta``).  Fills ``timings`` (if given) with
     ``scatter_s`` / ``update_s`` / ``gather_s`` / ``wall_s`` /
     ``overlap_ratio``.
+
+    ``tap``, if given, observes the assembled delta (identical bytes on
+    every replica by construction — the allgather fans out ONE wire-format
+    update) right before it is returned: the hot-spare delta feed rides
+    this hook so parked observers can keep a shadow bit-exact without
+    participating in the collective.  A tap failure never fails the sync.
     """
     t_wall = time.perf_counter()
     n = flat.size
@@ -559,6 +566,11 @@ def outer_sharded_sync(
     tm["overlap_ratio"] = round(busy / tm["wall_s"], 4) if tm["wall_s"] > 0 else 0.0
     if timings is not None:
         timings.update({k: round(v, 6) for k, v in tm.items()})
+    if tap is not None:
+        try:
+            tap(delta_full[:n])
+        except Exception:  # noqa: BLE001 — observers must not fail the sync
+            pass
     return delta_full[:n]
 
 

@@ -2567,6 +2567,17 @@ class TCPCommunicator(Communicator):
         self._op_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._epoch = 0
+        # count of ops currently executing on the op thread (plus queued
+        # ones via self._ops.qsize) — the foreground-busy probe behind
+        # busy(), which idle-priority traffic (spare warm serving) polls to
+        # yield to live collectives.  Updated under its own lock: an old
+        # epoch's op thread can overlap the new epoch's (teardown queues a
+        # sentinel but never joins), and an unsynchronized += / -= pair
+        # racing across threads can lose an update, sticking the counter
+        # above zero (warm serving waits the full yield window forever) or
+        # below (warm serving never yields).
+        self._inflight_ops = 0
+        self._inflight_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -2673,6 +2684,16 @@ class TCPCommunicator(Communicator):
 
     def set_timeout(self, timeout_s: float) -> None:
         self._timeout_s = timeout_s
+
+    def busy(self) -> bool:
+        """True while a collective/p2p op is executing or queued in the
+        current epoch.  Idle-priority consumers (the manager server's
+        spare warm-range handler) poll this to yield the NIC to foreground
+        collectives; a racy read only costs one brief extra yield."""
+        if self._inflight_ops > 0:
+            return True
+        ops = self._ops
+        return ops is not None and not ops.empty()
 
     def arm_faults(self, spec: Union[str, _FaultProgram, None]) -> None:
         """Arm (or with ``None`` disarm) a per-link fault program at
@@ -2834,6 +2855,8 @@ class TCPCommunicator(Communicator):
                     epoch, f"op timed out after {timeout_s}s"
                 ),
             )
+            with self._inflight_lock:
+                self._inflight_ops += 1
             try:
                 result = fn()
             except BaseException as e:  # noqa: BLE001
@@ -2859,6 +2882,8 @@ class TCPCommunicator(Communicator):
             else:
                 fut.set_result(result)
             finally:
+                with self._inflight_lock:
+                    self._inflight_ops -= 1
                 handle.cancel()
 
     def _submit(

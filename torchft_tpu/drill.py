@@ -70,12 +70,34 @@ def gray_failure_drill(
     - ``partition``: one replica is cut off (data-plane partition mask +
       paused heartbeats).  The MAJORITY side must form a quorum without it
       (anti split-brain keeps the minority down).
+    - ``spare_promote``: a hot spare (wire-v3 SPARE role) warms beside
+      ``num_replicas`` actives; one active is killed and the lighthouse
+      must promote the spare in the SAME membership edit — the report
+      carries ``promotion_latency_s`` (kill → promoted spare's first
+      commit, the drill's ``mean_heal_in_s``) and ``warm_lag_steps``.
+    - ``kill_spare``: the spare is killed MID-WARM; the active fleet must
+      finish every step with ZERO quorum reconfigurations and bit-identical
+      params — a dying spare never poisons or stalls the fleet.
 
     Returns summary facts (also asserted internally)."""
     from torchft_tpu.chaos import ChaosController, Failure, ThreadReplica
     from torchft_tpu.communicator import TCPCommunicator
     from torchft_tpu.lighthouse import LighthouseServer
     from torchft_tpu.manager import Manager
+
+    if mode in ("spare_promote", "kill_spare"):
+        # hot-spare chaos rides the same drill surface (and report keys:
+        # promotion_latency_s / warm_lag_steps match the bench gate) but a
+        # very different fleet shape — stateful replicas plus a warming
+        # spare — so it runs its own scaffolding
+        return _spare_drill(
+            mode=mode,
+            num_replicas=num_replicas,
+            steps=steps,
+            payload_elems=payload_elems,
+            arm_at_step=arm_at_step,
+            timeout_s=timeout_s,
+        )
 
     assert mode in ("net_flaky", "slow_nic", "partition"), mode
     assert num_replicas >= 3, "gray drills need a majority side"
@@ -287,6 +309,290 @@ def gray_failure_drill(
         for t in threads:
             t.join(timeout=5.0)
         for r in replicas:
+            try:
+                r.manager.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        lighthouse.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return result
+
+
+def _spare_drill(
+    mode: str,
+    num_replicas: int = 3,
+    steps: int = 12,
+    payload_elems: int = 50_000,
+    arm_at_step: int = 3,
+    timeout_s: float = 20.0,
+) -> Dict[str, Any]:
+    """Hot-spare chaos: ``num_replicas`` stateful actives + 1 warming spare
+    (see :func:`gray_failure_drill` for the mode contracts)."""
+    from torchft_tpu.chaos import ChaosController, Failure, ThreadReplica
+    from torchft_tpu.communicator import TCPCommunicator
+    from torchft_tpu.lighthouse import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.spare import SpareAgent
+
+    assert mode in ("spare_promote", "kill_spare"), mode
+    assert num_replicas >= 2, "spare drills need a surviving majority"
+
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("TORCHFT_SPARE_WARM_REFRESH_S", "TORCHFT_SPARE_PROMOTE")
+    }
+    # restage the warm snapshot every committed step: the drill's steps are
+    # fast, and a spare warm to the commit front is the promotion case the
+    # gate measures
+    os.environ["TORCHFT_SPARE_WARM_REFRESH_S"] = "0"
+    # promotion stays OFF until the fleet is armed: the drill's tight
+    # heartbeat window (300 ms — sized for sub-second death detection)
+    # means a busy host can miss an active's beat during the startup
+    # scramble, and promoting the still-cold spare over a LIVE replica
+    # wedges rendezvous (observed in the bench-smoke parent process, where
+    # the spare phase runs after minutes of fleet subprocesses).  The env
+    # knob is read per quorum_compute call, so flipping it after arming
+    # takes effect immediately.
+    os.environ["TORCHFT_SPARE_PROMOTE"] = "0"
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=num_replicas - 1,
+        join_timeout_ms=300,
+        quorum_tick_ms=10,
+        # death detection dominates promotion latency: the sub-second gate
+        # needs a tight heartbeat window (production sizing in
+        # docs/operations.md §12)
+        heartbeat_timeout_ms=300,
+    )
+
+    class _Rep:
+        def __init__(self, idx: int, role: str = "active") -> None:
+            self.idx = idx
+            self.role = role
+            self.params = np.zeros(payload_elems, dtype=np.float32)
+            self.comm = TCPCommunicator(timeout_s=timeout_s)
+            self.manager = Manager(
+                comm=self.comm,
+                load_state_dict=self._load,
+                state_dict=self._save,
+                min_replica_size=num_replicas - 1,
+                replica_id=f"spare_drill_{role}_{idx}",
+                lighthouse_addr=lighthouse.local_address(),
+                timeout=timeout_s,
+                quorum_timeout=timeout_s,
+                connect_timeout=timeout_s,
+                role=role,
+            )
+            self.commits = 0
+            self.reconfigs_after_arm = 0
+            self.qid_at_arm: Optional[int] = None
+            self.kill_flag = threading.Event()
+            self.first_commit_after_kill_ts: Optional[float] = None
+
+        def _save(self) -> Dict[str, Any]:
+            return {"params": self.params.copy()}
+
+        def _load(self, sd: Dict[str, Any]) -> None:
+            self.params = np.asarray(sd["params"], dtype=np.float32).copy()
+
+        def active_loop(self, stop: threading.Event) -> None:
+            # distinct per-replica gradients: final bit-identity across the
+            # fleet is only possible if everyone applied the same averages
+            grad = np.full(payload_elems, float(self.idx + 1), dtype=np.float32)
+            while not stop.is_set() and self.manager.current_step() < steps:
+                if (
+                    not warm_gate.is_set()
+                    and self.manager.current_step() >= arm_at_step + 2
+                ):
+                    # don't burn through the step budget before the spare
+                    # has warmed (it would end the drill with nothing to
+                    # promote) — same rendezvous hazard joint_ft_spmd_drill
+                    # gates with its ``rejoined`` event
+                    warm_gate.wait(timeout=120.0)
+                if self.kill_flag.is_set():
+                    # hard death: heartbeats stop, peers' collectives fail.
+                    # kill_ts is the moment death actually lands (the flag
+                    # is polled at step boundaries), the analog of the
+                    # bench's SIGKILL timestamp
+                    kill_ts[0] = kill_ts[0] or time.monotonic()
+                    self.manager.shutdown()
+                    return
+                try:
+                    self.manager.start_quorum()
+                    work = self.manager.allreduce(grad.copy())
+                    avg = work.wait(timeout=timeout_s)
+                    ok = self.manager.should_commit()
+                except Exception:  # noqa: BLE001 — a failed step, not a crash
+                    ok = False
+                if ok and not stop.is_set():
+                    self.params += avg
+                    self.commits += 1
+                    if self.first_commit_after_kill_ts is None and kill_ts[0]:
+                        self.first_commit_after_kill_ts = time.monotonic()
+                    if (
+                        self.qid_at_arm is not None
+                        and self.manager._quorum_id != self.qid_at_arm
+                    ):
+                        self.reconfigs_after_arm += 1
+                        self.qid_at_arm = self.manager._quorum_id
+
+    kill_ts: List[float] = [0.0]
+    stop = threading.Event()
+    warm_gate = threading.Event()
+    actives = [_Rep(i) for i in range(num_replicas)]
+    spare = _Rep(num_replicas, role="spare")
+    agent = SpareAgent(spare.manager)
+    promoted = threading.Event()
+
+    def spare_loop() -> None:
+        while not stop.is_set() and not spare.kill_flag.is_set():
+            if agent.step(park_timeout_s=1.0):
+                promoted.set()
+                spare.active_loop(stop)
+                return
+        if spare.kill_flag.is_set():
+            # die mid-warm: sever everything at once (heartbeats included)
+            spare.manager.shutdown()
+
+    threads = [
+        threading.Thread(target=r.active_loop, args=(stop,), daemon=True)
+        for r in actives
+    ]
+    spare_thread = threading.Thread(target=spare_loop, daemon=True)
+    victim = actives[num_replicas - 1]
+    chaos = ChaosController(
+        [ThreadReplica(f"rep_{r.idx}", r) for r in actives]
+        + [ThreadReplica("spare", spare)]
+    )
+    result: Dict[str, Any] = {}
+    try:
+        for t in threads:
+            t.start()
+        spare_thread.start()
+        # arm gate: fleet committing AND the spare demonstrably warm
+        deadline = time.monotonic() + 120.0
+        while (
+            min(r.commits for r in actives) < arm_at_step
+            or agent.warm_step < 1
+        ) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert min(r.commits for r in actives) >= arm_at_step, (
+            "fleet never reached the arming step"
+        )
+        assert agent.warm_step >= 1, "spare never warmed"
+        for r in actives:
+            r.qid_at_arm = r.manager._quorum_id
+        warm_lag_at_arm = float(agent.metrics.get("warm_lag_steps", 0.0))
+        # armed: the spare is demonstrably warm, so promotion is now safe
+        # (and in kill_spare mode its absence is what the drill asserts —
+        # a dead spare must never be promoted)
+        os.environ["TORCHFT_SPARE_PROMOTE"] = "1"
+        warm_gate.set()
+
+        if mode == "spare_promote":
+            chaos.inject(Failure.KILL, victim=chaos.replicas[victim.idx])
+            kill_deadline = time.monotonic() + 60.0
+            while not kill_ts[0] and time.monotonic() < kill_deadline:
+                time.sleep(0.01)
+            assert kill_ts[0], "victim never died"
+            survivors = [r for r in actives if r is not victim] + [spare]
+            assert promoted.wait(timeout=60.0), "spare was never promoted"
+            deadline = time.monotonic() + 240.0
+            while (
+                min(r.manager.current_step() for r in survivors) < steps
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            stop.set()
+            for t in threads + [spare_thread]:
+                t.join(timeout=2 * timeout_s + 10.0)
+            assert all(
+                r.manager.current_step() >= steps for r in survivors
+            ), f"fleet stalled after promotion: {[r.commits for r in survivors]}"
+            assert spare.first_commit_after_kill_ts is not None
+            status = lighthouse._status()
+            assert status["promotions_total"] >= 1, status
+            # the ONE membership edit the death was always going to cost
+            # (dead active out + spare in, same quorum computation)
+            survivors_reconf = [r for r in actives if r is not victim]
+            assert all(r.reconfigs_after_arm == 1 for r in survivors_reconf), (
+                f"expected exactly one membership edit: "
+                f"{[r.reconfigs_after_arm for r in survivors_reconf]}"
+            )
+            promotion_latency = (
+                spare.first_commit_after_kill_ts - kill_ts[0]
+            )
+            result.update(
+                promotion_latency_s=round(promotion_latency, 3),
+                mean_heal_in_s=round(promotion_latency, 3),
+                warm_lag_steps=float(
+                    agent.metrics.get("promote_warm_lag_steps", 0.0)
+                ),
+                promotion_adopt_s=agent.metrics.get("promotion_adopt_s"),
+                promotions_total=status["promotions_total"],
+                # per-survivor (asserted identical above): the ONE
+                # membership edit, not a sum over observers
+                quorum_reconfigs=survivors_reconf[0].reconfigs_after_arm,
+            )
+            fleet = survivors
+        else:  # kill_spare
+            chaos.inject(Failure.SPARE, victim=chaos.replicas[-1])
+            kill_ts[0] = time.monotonic()
+            deadline = time.monotonic() + 240.0
+            while (
+                min(r.manager.current_step() for r in actives) < steps
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            stop.set()
+            for t in threads + [spare_thread]:
+                t.join(timeout=2 * timeout_s + 10.0)
+            assert all(
+                r.manager.current_step() >= steps for r in actives
+            ), f"fleet stalled after spare death: {[r.commits for r in actives]}"
+            reconfigs = sum(r.reconfigs_after_arm for r in actives)
+            assert reconfigs == 0, (
+                f"{reconfigs} quorum reconfigurations after killing the "
+                "spare (a spare's death must never touch the active fleet)"
+            )
+            assert not promoted.is_set(), "dead spare was promoted"
+            result.update(
+                quorum_reconfigs=0,
+                warm_lag_steps=warm_lag_at_arm,
+                promotions_total=lighthouse._status()["promotions_total"],
+            )
+            fleet = list(actives)
+
+        # bit-identity: every surviving replica holds the same params —
+        # neither the promotion handshake nor a dying spare forked state
+        ref = fleet[0].params
+        for other in fleet[1:]:
+            assert np.array_equal(ref, other.params), (
+                "fleet params diverged "
+                f"({fleet[0].idx} vs {other.idx})"
+            )
+        result.update(
+            commits=[r.commits for r in fleet],
+            warm_bytes_fetched=float(
+                agent.metrics.get("warm_bytes_fetched", 0.0)
+            ),
+            warm_deltas_applied=float(
+                agent.metrics.get("warm_deltas_applied", 0.0)
+            ),
+        )
+    finally:
+        stop.set()
+        warm_gate.set()
+        spare.kill_flag.set()
+        for t in threads + [spare_thread]:
+            t.join(timeout=5.0)
+        agent.close()
+        for r in actives + [spare]:
             try:
                 r.manager.shutdown()
             except Exception:  # noqa: BLE001

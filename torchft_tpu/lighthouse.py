@@ -40,6 +40,9 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from torchft_tpu.wire import (
+    ROLE_ACTIVE,
+    ROLE_SPARE,
+    WIRE_COMPAT_ENV,
     CommHealth,
     ErrCode,
     MsgType,
@@ -52,6 +55,7 @@ from torchft_tpu.wire import (
     configure_server_socket,
     create_listener,
     connect,
+    manager_quorum_wire_version,
     raise_if_error,
     recv_frame,
     send_error,
@@ -79,6 +83,56 @@ EVICT_PERSIST_ENV = "TORCHFT_EVICT_PERSIST"  # default 3
 
 def _evict_slow_enabled() -> bool:
     return os.environ.get(EVICT_SLOW_ENV, "0").lower() in ("1", "true", "on")
+
+
+# Hot-spare promotion (wire v3 SPARE role).  A spare registers via the
+# quorum RPC with role=SPARE: it heartbeats and receives every quorum
+# broadcast (riding the version-gated ``spares`` tail) but never counts
+# toward min_replicas or the anti-split-brain majority and never enters the
+# participant list — so a spare joining, warming, or DYING never bumps
+# quorum_id or reconfigures the active fleet.  When an active member of the
+# previous quorum stops heartbeating, the lighthouse promotes the freshest
+# healthy spare (max reported warm step, ties to the lowest replica_id) in
+# the SAME quorum computation that would have shrunk the fleet: the spare
+# moves into the candidate set and the resulting membership edit is the one
+# quorum_id bump the failure was always going to cost.
+SPARE_PROMOTE_ENV = "TORCHFT_SPARE_PROMOTE"
+# a spare lagging the fleet by more than this many steps is too cold to
+# promote (it would stall the quorum on a bulk heal anyway; let the fleet
+# shrink and the spare keep warming)
+SPARE_MAX_LAG_ENV = "TORCHFT_SPARE_MAX_LAG"  # default: unlimited
+# Spare liveness is judged on a LAXER bound than active death detection:
+# a sub-second heartbeat_timeout sized for fast failure detection also
+# means one scheduler-starved beat from the spare (whose process spends
+# its time warming, not spinning on the control plane) would make it
+# ineligible at exactly the promotion instant — and a missed promotion is
+# PERMANENT once the shrunk quorum becomes prev (dead members of the old
+# prev are no longer anyone's to replace).  A spare this stale may be
+# dead; the cost of wrongly promoting one is a single wedged round (the
+# fleet sheds it at the next heartbeat verdict), while the cost of
+# wrongly skipping one is the full cold heal-in the spare existed to
+# avoid.  Registration pruning stays at 4x.
+_SPARE_FRESH_FACTOR = 3.0
+
+
+def _spare_promote_enabled() -> bool:
+    return os.environ.get(SPARE_PROMOTE_ENV, "1").lower() not in (
+        "0",
+        "false",
+        "off",
+    )
+
+
+def _spare_max_lag() -> Optional[int]:
+    raw = os.environ.get(SPARE_MAX_LAG_ENV)
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"unparseable {SPARE_MAX_LAG_ENV}={raw!r} (expected int)"
+        ) from e
 
 
 def _evict_knobs() -> Tuple[float, float, int]:
@@ -139,6 +193,23 @@ class _State:
     evicted_now: List[str] = field(default_factory=list)
     evicted_prev: set = field(default_factory=set)
     evictions_total: int = 0
+    # hot spares: registered SPARE-role members, kept OUT of participants
+    # (and out of every membership count) until promoted.  ``spare_ids``
+    # remembers which heartbeating replica ids are spares so majority math
+    # never counts them; ``promoted`` pins ids the lighthouse flipped to
+    # active until the replica itself re-registers with role=ACTIVE.
+    spares: Dict[str, _MemberDetails] = field(default_factory=dict)
+    spare_ids: set = field(default_factory=set)
+    promoted: set = field(default_factory=set)
+    promoted_now: List[str] = field(default_factory=list)
+    promotions_total: int = 0
+    # hold-the-shrink anchors: when each prev member was FIRST observed
+    # absent-but-heartbeat-fresh (the window must run from the member's
+    # own disappearance — anchoring on the survivors' park time can expire
+    # BEFORE the missing member's heartbeat does, issuing the shrink while
+    # the member still counts healthy and permanently missing the
+    # promotion once the shrunk quorum becomes prev)
+    hold_since: Dict[str, float] = field(default_factory=dict)
 
 
 # health entries stop counting as straggler-median "reporters" after this
@@ -208,18 +279,97 @@ def _evaluate_stragglers(state: _State, updated_id: str, now: float) -> None:
         )
 
 
+def _promote_spares(
+    now: float, state: _State, cfg: LighthouseConfig, healthy_replicas: set
+) -> None:
+    """Hot-spare promotion: when a previous-quorum member stopped
+    heartbeating, move the freshest healthy spare(s) into the participant
+    set — the same membership edit the death was always going to cost,
+    minus the shrink.  Mutates ``state`` (tick path only; ``_status`` calls
+    ``quorum_compute`` with ``allow_promote=False``)."""
+    state.promoted_now = []
+    if not _spare_promote_enabled() or state.prev_quorum is None:
+        return
+    if any(d.member.shrink_only for d in state.participants.values()):
+        # a shrink_only round restricts membership to prev members — a
+        # promotion would smuggle a new member into exactly the quorum the
+        # caller asked to only ever shrink
+        return
+    hb_timeout_s = cfg.heartbeat_timeout_ms / 1000.0
+    prev = state.prev_quorum.participants
+    prev_ids = {m.replica_id for m in prev}
+    dead_prev = {
+        m.replica_id for m in prev if m.replica_id not in healthy_replicas
+    }
+    # promotions from EARLIER ticks that are already standing in for the
+    # same deaths: a promoted spare stays in ``participants`` (and in
+    # ``promoted``) until the quorum issues, but ``dead_prev`` is
+    # recomputed from the unchanged prev_quorum every tick — without this
+    # offset each tick would burn another spare on the same dead member
+    # and the replacement quorum would GROW past the old world size.
+    already_replacing = sum(
+        1
+        for rid in state.participants
+        if rid in state.promoted and rid not in prev_ids
+    )
+    slots = len(dead_prev) - already_replacing
+    if slots <= 0 or not state.spares:
+        return
+    eligible = [
+        d
+        for rid, d in state.spares.items()
+        if now - state.heartbeats.get(rid, float("-inf"))
+        < _SPARE_FRESH_FACTOR * hb_timeout_s
+    ]
+    max_lag = _spare_max_lag()
+    if max_lag is not None:
+        prev_max_step = max((m.step for m in prev), default=0)
+        eligible = [
+            d for d in eligible if d.member.step >= prev_max_step - max_lag
+        ]
+    # freshest first (max warm step), ties to the lowest replica_id
+    eligible.sort(key=lambda d: (-d.member.step, d.member.replica_id))
+    for details in eligible[:slots]:
+        rid = details.member.replica_id
+        state.spares.pop(rid)
+        state.spare_ids.discard(rid)
+        state.promoted.add(rid)
+        state.participants[rid] = _MemberDetails(
+            joined=now, member=details.member
+        )
+        healthy_replicas.add(rid)
+        state.promoted_now.append(rid)
+        state.promotions_total += 1
+        logger.warning(
+            "promoting spare %s (warm step %d) to replace dead %s",
+            rid,
+            details.member.step,
+            ", ".join(sorted(dead_prev)),
+        )
+
+
 def quorum_compute(
-    now: float, state: _State, cfg: LighthouseConfig
+    now: float,
+    state: _State,
+    cfg: LighthouseConfig,
+    allow_promote: bool = True,
 ) -> Tuple[Optional[List[QuorumMember]], str]:
     """Decide whether a quorum can be issued right now.
 
     Pure function mirroring ``quorum_compute`` (``src/lighthouse.rs:141-269``)
-    so the full Rust unit-test matrix applies directly.
+    so the full Rust unit-test matrix applies directly.  Registered spares
+    never count toward ``min_replicas`` or the anti-split-brain majority;
+    ``allow_promote`` gates the one mutation (spare → participant) so a
+    status read stays side-effect free.
     """
     hb_timeout_s = cfg.heartbeat_timeout_ms / 1000.0
     healthy_replicas = {
-        rid for rid, ts in state.heartbeats.items() if now - ts < hb_timeout_s
+        rid
+        for rid, ts in state.heartbeats.items()
+        if now - ts < hb_timeout_s and rid not in state.spare_ids
     }
+    if allow_promote:
+        _promote_spares(now, state, cfg, healthy_replicas)
     healthy_participants = {
         rid: d for rid, d in state.participants.items() if rid in healthy_replicas
     }
@@ -256,6 +406,12 @@ def quorum_compute(
             if state.evicted_now
             else ""
         )
+        + (
+            f"[promoting spare: {', '.join(state.promoted_now)}]"
+            if state.promoted_now
+            else ""
+        )
+        + (f"[{len(state.spares)} spares]" if state.spares else "")
     )
 
     if state.prev_quorum is not None:
@@ -299,6 +455,60 @@ def quorum_compute(
             f"healthy but not participating stragglers due to join timeout "
             f"{metadata}",
         )
+
+    # Hold-the-shrink: a freshly-dead prev member still has a fresh
+    # heartbeat for up to heartbeat_timeout, so the join-timeout path above
+    # would issue a SHRUNK quorum first — and promotion (which replaces
+    # dead members of prev_quorum) could then never fire.  While a warm
+    # spare is registered and a prev member is absent-but-heartbeat-fresh,
+    # defer the shrink until the heartbeat verdict lands: either the member
+    # re-registers (fast quorum) or its heartbeat expires and the promotion
+    # above replaces it in the same computation.  Bounded by join+heartbeat
+    # timeouts so a wedged replica that keeps heartbeating but never
+    # re-registers is still shed, just one heartbeat window later.
+    if (
+        allow_promote
+        and _spare_promote_enabled()
+        and not shrink_only
+        and state.spares
+        and state.prev_quorum
+    ):
+        missing_fresh = sorted(
+            rid
+            for rid in (m.replica_id for m in state.prev_quorum.participants)
+            if rid not in healthy_participants and rid in healthy_replicas
+        )
+        # the hold window runs per missing member from ITS first observed
+        # absence (a re-registered or heartbeat-expired member drops out
+        # of missing_fresh and its anchor is pruned); a wedged member that
+        # keeps beating but never re-registers escapes the hold after the
+        # bounded window, so the shrink is delayed, never denied
+        for rid in list(state.hold_since):
+            if rid not in missing_fresh:
+                del state.hold_since[rid]
+        # same laxer liveness bound promotion eligibility uses: the hold
+        # must never wait for a verdict the promotion would then refuse
+        spare_fresh = any(
+            now - state.heartbeats.get(rid, float("-inf"))
+            < _SPARE_FRESH_FACTOR * hb_timeout_s
+            for rid in state.spares
+        )
+        hold_window_s = (
+            cfg.join_timeout_ms + cfg.heartbeat_timeout_ms
+        ) / 1000.0
+        held = [
+            rid
+            for rid in missing_fresh
+            if spare_fresh
+            and now - state.hold_since.setdefault(rid, now) < hold_window_s
+        ]
+        if held:
+            return None, (
+                f"Holding shrink: prev member(s) {', '.join(held)} "
+                f"absent but heartbeat-fresh with a warm spare registered — "
+                f"waiting for the heartbeat verdict (rejoin or promotion) "
+                f"{metadata}"
+            )
 
     return candidates, f"Valid quorum found {metadata}"
 
@@ -437,13 +647,40 @@ class LighthouseServer:
                 state.quorum_id,
             )
 
+        hb_timeout_s = self._cfg.heartbeat_timeout_ms / 1000.0
+        now = time.monotonic()
         quorum = Quorum(
             quorum_id=state.quorum_id,
             participants=list(participants),
             created=time.time(),
+            # registered healthy spares ride the version-gated tail: every
+            # member (and each spare itself) learns the spare set without
+            # the spares ever counting as membership
+            spares=sorted(
+                (
+                    d.member
+                    for rid, d in state.spares.items()
+                    if now - state.heartbeats.get(rid, float("-inf"))
+                    < _SPARE_FRESH_FACTOR * hb_timeout_s
+                ),
+                key=lambda m: m.replica_id,
+            ),
         )
         state.prev_quorum = quorum
         state.participants.clear()
+        state.hold_since.clear()  # fresh prev quorum, fresh hold anchors
+        # spare registrations are STICKY (unlike participants): a spare
+        # spends most of its time warming, not parked on a quorum RPC, and
+        # promotion must find it registered the instant an active dies.
+        # Dead spares are pruned on heartbeat age instead.
+        for rid in [
+            rid
+            for rid in state.spares
+            if now - state.heartbeats.get(rid, float("-inf"))
+            > 4 * hb_timeout_s
+        ]:
+            del state.spares[rid]
+            state.spare_ids.discard(rid)
         # Atomically re-register parked waiters the new quorum excluded.
         # The reference re-registers from the waiter's own loop
         # (src/lighthouse.rs:534-543), which can livelock when fast-stepping
@@ -537,22 +774,71 @@ class LighthouseServer:
         self, requester: QuorumMember, refresh_heartbeat: bool = True
     ) -> None:
         now = time.monotonic()
+        state = self._state
+        rid = requester.replica_id
         if refresh_heartbeat:
-            self._state.heartbeats[requester.replica_id] = now  # implicit heartbeat
-        self._state.participants[requester.replica_id] = _MemberDetails(
-            joined=now, member=requester
-        )
+            state.heartbeats[rid] = now  # implicit heartbeat
+        if requester.role == ROLE_SPARE and rid not in state.promoted:
+            state.spares[rid] = _MemberDetails(joined=now, member=requester)
+            state.spare_ids.add(rid)
+            state.participants.pop(rid, None)
+            return
+        if requester.role != ROLE_SPARE:
+            # an explicit active registration acknowledges a promotion (or
+            # was never a spare); either way this id now counts as active
+            state.promoted.discard(rid)
+            state.spare_ids.discard(rid)
+        state.spares.pop(rid, None)
+        state.participants[rid] = _MemberDetails(joined=now, member=requester)
 
     def _handle_quorum(self, conn: socket.socket, r: Reader) -> None:
         requester = QuorumMember.decode(r)
         timeout_ms = r.u64()
+        # v3 role tail (absent on legacy clients and active members)
+        if not r.done() and r.u32() >= 3:
+            requester.role = r.u8()
         deadline = time.monotonic() + timeout_ms / 1000.0
         logger.info("Received quorum request for replica %s", requester.replica_id)
 
         token = object()
         failure: Optional[Tuple[ErrCode, str]] = None
+        promoted_fast = False
         with self._lock:
             self._register(requester)
+            # Promotion fast-path: a spare the tick loop promoted INTO the
+            # standing quorum was (by design) probably warming, not parked,
+            # when that quorum was issued — parking it for the NEXT quorum
+            # would deadlock against actives already blocked in mesh
+            # rendezvous waiting for it.  Hand it the standing quorum now.
+            # The ``promoted`` pin is REQUIRED alongside prev membership:
+            # a crashed active relaunched by its supervisor as role=spare
+            # under the same replica_id also matches prev.participants, and
+            # handing THAT cold process the standing quorum would let it
+            # join collectives on fresh state (heal=False when the prev
+            # member's step equals max_step) — it must park and re-enter
+            # as an ordinary warming spare instead.
+            if requester.role == ROLE_SPARE:
+                prev = self._state.prev_quorum
+                if (
+                    prev is not None
+                    and requester.replica_id in self._state.promoted
+                    and any(
+                        p.replica_id == requester.replica_id
+                        for p in prev.participants
+                    )
+                ):
+                    quorum = prev
+                    promoted_fast = True
+        if promoted_fast:
+            conn.settimeout(30.0)
+            try:
+                w = Writer()
+                quorum.encode(w)
+                send_frame(conn, MsgType.LH_QUORUM_RESP, w.payload())
+            finally:
+                conn.settimeout(None)
+            return
+        with self._lock:
             self._parked[token] = requester
             gen = self._generation
             try:
@@ -562,7 +848,10 @@ class LighthouseServer:
                         gen = self._generation
                         quorum = self._state.prev_quorum
                         assert quorum is not None
-                        if any(
+                        # spares receive EVERY issued quorum (their live view
+                        # of membership + max_step); a promoted spare shows
+                        # up in participants and learns it from the result
+                        if requester.role == ROLE_SPARE or any(
                             p.replica_id == requester.replica_id
                             for p in quorum.participants
                         ):
@@ -605,9 +894,12 @@ class LighthouseServer:
             now = time.monotonic()
             # quorum_compute writes state.evicted_now (the tick loop's
             # eviction-accounting channel); a status read must stay
-            # side-effect free, so snapshot and restore it
+            # side-effect free, so snapshot/restore it and disable the
+            # spare-promotion mutation
             saved_evicted = list(self._state.evicted_now)
-            _, reason = quorum_compute(now, self._state, self._cfg)
+            _, reason = quorum_compute(
+                now, self._state, self._cfg, allow_promote=False
+            )
             self._state.evicted_now = saved_evicted
             prev = self._state.prev_quorum
             max_step = (
@@ -659,6 +951,31 @@ class LighthouseServer:
                 "evict_slow_enabled": _evict_slow_enabled(),
                 "evicted_replicas": list(self._state.evicted_now),
                 "evictions_total": self._state.evictions_total,
+                # hot-spare table: who is parked warm, how far each shadow
+                # lags the commit front, and how many promotions have fired.
+                # A spare's "step" is the warm watermark it reported with
+                # its last registration — warm_lag_steps is the promotion
+                # cost in fragment deltas.
+                "spare_promote_enabled": _spare_promote_enabled(),
+                "spares": [
+                    {
+                        "replica_id": d.member.replica_id,
+                        "address": d.member.address,
+                        "warm_step": d.member.step,
+                        "warm_lag_steps": max(0, max_step - d.member.step)
+                        if max_step >= 0
+                        else None,
+                        "heartbeat_age_s": round(
+                            now
+                            - self._state.heartbeats.get(
+                                d.member.replica_id, now
+                            ),
+                            2,
+                        ),
+                    }
+                    for _rid, d in sorted(self._state.spares.items())
+                ],
+                "promotions_total": self._state.promotions_total,
             }
 
     def _handle_http(self, conn: socket.socket) -> None:
@@ -749,6 +1066,24 @@ class LighthouseServer:
             if health_rows
             else ""
         )
+        spare_rows = "".join(
+            f"<tr><td><code>{html.escape(sp['replica_id'])}</code></td>"
+            f"<td>{sp['warm_step']}</td><td>{sp['warm_lag_steps']}</td>"
+            f"<td>{sp['heartbeat_age_s']}s</td>"
+            f"<td><code>{html.escape(sp['address'])}</code></td></tr>"
+            for sp in s["spares"]
+        )
+        spare_tbl = (
+            "<h2>hot spares</h2><table border=1 cellpadding=4>"
+            "<tr><th>spare</th><th>warm step</th><th>lag (steps)</th>"
+            "<th>beat</th><th>address</th></tr>"
+            f"{spare_rows}</table>"
+            f"<p>spare_promote="
+            f"{'on' if s['spare_promote_enabled'] else 'off'}"
+            f" · promotions_total={s['promotions_total']}</p>"
+            if spare_rows or s["promotions_total"]
+            else ""
+        )
         return (
             "<html><head><title>torchft_tpu lighthouse</title><style>"
             "body{font-family:monospace;margin:2em}.card{border:1px solid #999;"
@@ -758,7 +1093,8 @@ class LighthouseServer:
             f"<p>max_step={s['max_step']} · participants={s['num_participants']}"
             f" · heal sources={s['num_heal_sources']}"
             f" · lagging={html.escape(', '.join(s['lagging_replicas']) or 'none')}</p>"
-            f"{cards}{health_tbl}<h2>heartbeats</h2><ul>{beats}</ul></body></html>"
+            f"{cards}{health_tbl}{spare_tbl}"
+            f"<h2>heartbeats</h2><ul>{beats}</ul></body></html>"
         )
 
 
@@ -779,8 +1115,11 @@ class LighthouseClient(RpcClient):
         shrink_only: bool = False,
         commit_failures: int = 0,
         data: Optional[dict] = None,
+        role: int = ROLE_ACTIVE,
     ) -> Quorum:
-        """Block until a quorum containing this replica is issued.
+        """Block until a quorum containing this replica is issued (or, for
+        ``role=ROLE_SPARE``, until ANY quorum is issued — the spare's live
+        view of membership and the commit front).
 
         ``data`` is an arbitrary JSON-serializable dict carried opaquely in
         the member record (``src/lib.rs:430-451``).
@@ -794,10 +1133,24 @@ class LighthouseClient(RpcClient):
             shrink_only=shrink_only,
             commit_failures=commit_failures,
             data=json.dumps(data) if data else "",
+            role=role,
         )
         w = Writer()
         member.encode(w)
         w.u64(int(timeout * 1000))
+        if role != ROLE_ACTIVE:
+            if manager_quorum_wire_version() < 3:
+                # never degrade silently: dropping the role tail would
+                # register this spare as a full ACTIVE (counted toward
+                # min_replicas/majority) on the lighthouse
+                raise ValueError(
+                    f"role={role} requires quorum wire v3 "
+                    f"({WIRE_COMPAT_ENV} pins an older version)"
+                )
+            # version-gated tail: active members stay byte-identical to v2
+            # (a legacy or native-tier lighthouse never sees spare frames)
+            w.u32(3)
+            w.u8(role)
         msg_type, r = self.call(MsgType.LH_QUORUM_REQ, w.payload(), timeout)
         raise_if_error(msg_type, r)
         return Quorum.decode(r)

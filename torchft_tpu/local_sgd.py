@@ -521,6 +521,9 @@ class _Fragment:
                 global_params.append(g)
             self._apply_global(leaves, global_params, local)
             self._shard.commit_stage()
+            # hot spares: the committed delta (identical bytes on every
+            # replica) feeds parked spares' shadows — warm channel (a)
+            self._manager.publish_staged_outer_delta(self._index)
         elif committed and not sharded:
             import optax
 

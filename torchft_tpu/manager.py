@@ -64,6 +64,10 @@ QUORUM_RETRIES_ENV = "TORCHFT_QUORUM_RETRIES"
 # TORCHFT_HEAL_CHUNK_MB (serialization), TORCHFT_HEAL_MAX_SOURCES
 # (manager_server) and TORCHFT_HEAL_SOURCE_TIMEOUT_S (http_transport).
 HEAL_STRIPED_ENV = "TORCHFT_HEAL_STRIPED"
+# Hot spares: minimum seconds between warm-snapshot restagings on an
+# active replica that has registered spares (each restage host-copies the
+# state dict once; spares pull chunk ranges from whatever is staged).
+SPARE_WARM_REFRESH_S_ENV = "TORCHFT_SPARE_WARM_REFRESH_S"
 
 
 def _heal_striped_enabled() -> bool:
@@ -133,6 +137,7 @@ class Manager:
         _manager_client: Optional[ManagerClient] = None,
         _peer_client_factory: Optional[Callable[[str], ManagerClient]] = None,
         server_cls: Optional[type] = None,
+        role: str = "active",
     ) -> None:
         from torchft_tpu.observability import init_structured_logging
 
@@ -211,6 +216,38 @@ class Manager:
         self._quorum_future: Optional[concurrent.futures.Future] = None
         # phase wall-times of the most recent quorum round (see _async_quorum)
         self.last_quorum_timings: Dict[str, float] = {}
+        # hot spares: this replica's quorum role ("active" | "spare" — a
+        # spare drives spare.SpareAgent instead of the train loop and flips
+        # to active at promotion), the spare ids the last quorum advertised
+        # (gates warm staging / delta publishing on the active side), and
+        # the warm snapshot staged for spare chunk fetches
+        if role not in ("active", "spare"):
+            raise ValueError(f"role must be 'active' or 'spare', got {role!r}")
+        if role == "spare":
+            from torchft_tpu.wire import (
+                WIRE_COMPAT_ENV,
+                manager_quorum_wire_version,
+            )
+
+            if manager_quorum_wire_version() < 3:
+                # refusing beats silently degrading: without the v3 role
+                # tail the lighthouse would register this "spare" as a
+                # full ACTIVE — counting toward min_replicas/majority and
+                # training on a cold shadow at the first quorum
+                raise ValueError(
+                    "role='spare' requires quorum wire v3; unset (or raise) "
+                    f"{WIRE_COMPAT_ENV} on this replica"
+                )
+        self._role = role
+        self._spare_replica_ids: List[str] = []
+        self._warm_staged: Optional[tuple] = None
+        self._warm_staged_ts = 0.0
+        # set by SpareAgent at promotion: the next start_quorum is a no-op
+        # because the promotion quorum was already adopted
+        self._adopted_quorum = False
+        # delta-tap staging: the sharded outer sync taps its assembled
+        # delta here; published to the spare feed only on a committed vote
+        self._staged_outer_delta: Optional[bytes] = None
         # pipeline timings of the most recent sharded outer sync; ride the
         # next quorum-change event into torchft_quorums (outer_shard_*)
         self._outer_shard_stats: Dict[str, float] = {}
@@ -275,6 +312,8 @@ class Manager:
             bind_port = port or int(os.environ.get(MANAGER_PORT_ENV, 0))
             # server_cls lets deployments swap in the C++ sidecar
             # (torchft_tpu.native.CppManagerServer) — same construction surface
+            from torchft_tpu.wire import ROLE_ACTIVE, ROLE_SPARE
+
             self._manager_server = (server_cls or ManagerServer)(
                 replica_id=replica_id,
                 lighthouse_addr=lighthouse_addr,
@@ -286,7 +325,14 @@ class Manager:
                 connect_timeout=self._connect_timeout,
                 quorum_retries=quorum_retries,
                 health_fn=self._comm_health,
+                role=ROLE_SPARE if role == "spare" else ROLE_ACTIVE,
+                warm_fn=self._warm_snapshot,
             )
+            # idle-priority warm serving: spare chunk fetches yield to live
+            # collectives when the communicator exposes a busy probe
+            busy_fn = getattr(self._comm, "busy", None)
+            if callable(busy_fn) and hasattr(self._manager_server, "busy_fn"):
+                self._manager_server.busy_fn = busy_fn
             self._store.set(MANAGER_ADDR_KEY, self._manager_server.address().encode())
             self._store.set(REPLICA_ID_KEY, replica_id.encode())
 
@@ -366,6 +412,97 @@ class Manager:
         )
 
     # ------------------------------------------------------------------
+    # hot spares (warm channels + promotion handshake)
+    # ------------------------------------------------------------------
+
+    @property
+    def role(self) -> str:
+        """``"active"`` or ``"spare"``; a spare flips at promotion."""
+        return self._role
+
+    def _promote_to_active(self) -> None:
+        """Promotion handshake, spare side: from here on this replica
+        registers with role=ACTIVE (acknowledging the lighthouse's
+        promotion) and runs the normal train-loop state machine."""
+        from torchft_tpu.wire import ROLE_ACTIVE
+
+        self._role = "active"
+        if self._manager_server is not None:
+            self._manager_server.role = ROLE_ACTIVE
+
+    def _warm_snapshot(self) -> Optional[tuple]:
+        """Server hook: the currently staged ``(step, PytreePlan)``."""
+        return self._warm_staged
+
+    def _maybe_stage_warm(self) -> None:
+        """Active side of warm channel (b): after a commit, (re)stage a
+        chunk-addressable snapshot of the state dict for spare warm
+        fetches — rate-limited, entirely outside the heal path, and only
+        while the quorum actually advertises spares.  The host copy runs
+        on the quorum executor (behind this round's quorum RPC), NOT the
+        train thread — staging a multi-GB state dict inline would tax
+        every step by a full-model copy; the ``_state_dict_lock`` rwlock
+        gives the executor thread the same consistency the heal path's
+        executor-side ``send_checkpoint`` staging already relies on.
+        Never raises: a failed staging costs warmth, not the step."""
+        if (
+            self._manager_server is None
+            or not self._spare_replica_ids
+            or self._role != "active"
+        ):
+            return
+        interval = _env_timeout(SPARE_WARM_REFRESH_S_ENV, 1.0)
+        now = time.monotonic()
+        if self._warm_staged is not None and self._warm_staged[0] == self._step:
+            return
+        # rate-limit on the SUBMIT stamp, independent of whether a staging
+        # has landed yet: while the first copy is still queued (or staging
+        # keeps failing) the interval must still hold, or every round
+        # would queue another full-model copy on the quorum executor
+        if self._warm_staged_ts and now - self._warm_staged_ts < interval:
+            return
+        self._warm_staged_ts = now
+        self._executor.submit(self._stage_warm_now)
+
+    def _stage_warm_now(self) -> None:
+        """Executor-side body of :meth:`_maybe_stage_warm`."""
+        try:
+            from torchft_tpu.checkpointing.serialization import plan_pytree
+
+            plan = plan_pytree(self._manager_state_dict(), snapshot=True)
+            self._warm_staged = (self._step, plan)
+        except Exception as e:  # noqa: BLE001 — warmth is best-effort
+            self._logger.warn(f"warm snapshot staging failed: {e}")
+
+    def _stage_outer_delta(self, delta: "np.ndarray") -> None:
+        """collectives.outer_sharded_sync tap: hold the assembled delta
+        bytes until the commit vote decides their fate."""
+        self._staged_outer_delta = np.asarray(delta, dtype=np.float32).tobytes()
+
+    def publish_staged_outer_delta(self, frag: int) -> None:
+        """Publish the delta the last sharded sync staged — call ONLY after
+        a committed vote (an aborted sync's delta must never reach a
+        spare's shadow)."""
+        payload, self._staged_outer_delta = self._staged_outer_delta, None
+        if payload is not None:
+            self.publish_outer_delta(frag, payload)
+
+    def publish_outer_delta(self, frag: int, payload: bytes) -> None:
+        """Feed one COMMITTED outer-sync delta (identical bytes on every
+        replica by construction) to subscribed spares — warm channel (a).
+        No-op without a manager server or registered spares; never raises
+        (a dead feed must not fail the committed step it describes)."""
+        if self._manager_server is None or not self._spare_replica_ids:
+            return
+        publish = getattr(self._manager_server, "publish_delta", None)
+        if not callable(publish):
+            return  # C++ sidecar: no spare feed
+        try:
+            publish(self._step, frag, bytes(payload))
+        except Exception as e:  # noqa: BLE001
+            self._logger.warn(f"outer delta publish failed: {e}")
+
+    # ------------------------------------------------------------------
     # error funnel
     # ------------------------------------------------------------------
 
@@ -424,6 +561,13 @@ class Manager:
     ) -> None:
         """Compute a new quorum and ready the manager for a new step
         (``manager.py:560-615``)."""
+        if self._adopted_quorum:
+            # promotion handshake: the spare already adopted a quorum (and
+            # possibly a heal) for THIS step via spare.SpareAgent — a fresh
+            # RPC would park against actives mid-rendezvous.  Consume the
+            # flag; the pending future/recovery event fence as usual.
+            self._adopted_quorum = False
+            return
         if self._quorum_future is not None:
             try:
                 self._quorum_future.result()
@@ -445,6 +589,14 @@ class Manager:
             shrink_only=shrink_only,
             quorum_timeout=timeout or self._quorum_timeout,
         )
+        # hot spares, warm channel (b): (re)stage a chunk-addressable
+        # snapshot of the state dict for spare warm fetches.  HERE — not at
+        # the commit vote — because state is quiescent at a step boundary:
+        # every committed update is fully applied and ``_step`` labels it
+        # exactly (the same consistency model heal staging relies on).
+        # Submitted AFTER the quorum so the copy queues behind this
+        # round's RPC on the (single-thread) executor, never ahead of it.
+        self._maybe_stage_warm()
         if not self._use_async_quorum:
             # sync quorum (DiLoCo/LocalSGD): a failed quorum RPC funnels to
             # a False vote like everywhere else, never into the train loop
@@ -487,6 +639,23 @@ class Manager:
             commit_failures=self._commit_failures,
         )
         timings["quorum_rpc_s"] = time.monotonic() - t0
+        self._adopt_quorum(quorum, allow_heal, timings)
+
+    def _adopt_quorum(
+        self,
+        quorum,
+        allow_heal: bool,
+        timings: Dict[str, float],
+    ) -> None:
+        """Apply one quorum result: reconfigure the communicator on a
+        membership change, serve/fetch heals, and refresh participation
+        facts.  Factored out of :meth:`_async_quorum` so a promoted spare
+        can adopt the quorum it was handed by the promotion fast-path
+        WITHOUT issuing a fresh quorum RPC (the actives are already parked
+        in mesh rendezvous waiting for it)."""
+        # registered spares this round (v3; empty on legacy peers) gate the
+        # active-side warm channels
+        self._spare_replica_ids = list(quorum.spare_replica_ids)
 
         quorum_id = quorum.quorum_id
         replica_rank = quorum.replica_rank
@@ -1012,6 +1181,13 @@ class Manager:
                     should_quantize=should_quantize,
                     kind=kind or "int8",
                     timings=tm,
+                    # delta-tap: stage the (replica-identical) delta bytes
+                    # for the spare feed; published only on a committed vote
+                    tap=(
+                        self._stage_outer_delta
+                        if self._spare_replica_ids
+                        else None
+                    ),
                 )
                 fut.set_result(delta)
             except Exception as e:  # noqa: BLE001 — funnel, never raise

@@ -36,6 +36,8 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from torchft_tpu.lighthouse import LighthouseClient
 from torchft_tpu.wire import (
+    ROLE_ACTIVE,
+    ROLE_SPARE,
     ErrCode,
     ManagerQuorumResult,
     MsgType,
@@ -61,6 +63,28 @@ logger = logging.getLogger(__name__)
 # the healer.
 HEAL_MAX_SOURCES_ENV = "TORCHFT_HEAL_MAX_SOURCES"
 
+# Spare warm channels.  The outer-delta feed ring is bounded (a slow or
+# dead spare must never grow an active replica's memory): oldest entries
+# drop first and a spare that fell off the ring re-syncs via the warm
+# snapshot instead.
+SPARE_DELTA_BUF_MB_ENV = "TORCHFT_SPARE_DELTA_BUF_MB"  # default 128
+_SPARE_DELTA_MAX_ENTRIES = 64
+# one warm-range response must fit a wire frame with headroom
+_WARM_RANGE_MAX_BYTES = 48 << 20
+# how long a warm-range handler will wait for foreground collectives to
+# drain before serving anyway (idle priority, but never starvation)
+_WARM_YIELD_S = 0.25
+
+
+def _spare_delta_buf_bytes() -> int:
+    raw = os.environ.get(SPARE_DELTA_BUF_MB_ENV)
+    try:
+        return max(1 << 20, int(float(raw) * (1 << 20))) if raw else 128 << 20
+    except ValueError as e:
+        raise ValueError(
+            f"unparseable {SPARE_DELTA_BUF_MB_ENV}={raw!r} (expected MB)"
+        ) from e
+
 
 def compute_quorum_results(
     replica_id: str,
@@ -68,16 +92,46 @@ def compute_quorum_results(
     quorum: Quorum,
     init_sync: bool,
 ) -> ManagerQuorumResult:
-    """Derive this rank's view of a quorum (``src/manager.rs:489-625``)."""
+    """Derive this rank's view of a quorum (``src/manager.rs:489-625``).
+
+    A replica listed in the quorum's SPARE tail (not its participants)
+    gets the spare view: membership facts + every participant's manager
+    address for the warm channels, ``is_spare=True``, no rank and no heal
+    assignment — it must warm, not train."""
     participants = sorted(quorum.participants, key=lambda p: p.replica_id)
+    spare_ids = sorted(s.replica_id for s in quorum.spares)
 
     replica_rank = next(
         (i for i, p in enumerate(participants) if p.replica_id == replica_id), None
     )
     if replica_rank is None:
-        raise WireError(
-            ErrCode.NOT_FOUND,
-            f"replica {replica_id} not participating in returned quorum",
+        if replica_id not in spare_ids:
+            raise WireError(
+                ErrCode.NOT_FOUND,
+                f"replica {replica_id} not participating in returned quorum",
+            )
+        max_step = max((p.step for p in participants), default=0)
+        max_participants = [p for p in participants if p.step == max_step]
+        return ManagerQuorumResult(
+            quorum_id=quorum.quorum_id,
+            replica_rank=-1,
+            replica_world_size=len(participants),
+            store_address=(
+                max_participants[group_rank % len(max_participants)].store_address
+                if max_participants
+                else ""
+            ),
+            max_step=max_step,
+            max_replica_rank=None,
+            max_world_size=len(max_participants),
+            heal=False,
+            commit_failures=max(
+                (p.commit_failures for p in participants), default=0
+            ),
+            replica_ids=[p.replica_id for p in participants],
+            is_spare=True,
+            spare_replica_ids=spare_ids,
+            all_manager_addresses=[p.address for p in participants],
         )
 
     max_step = max(p.step for p in participants)
@@ -156,6 +210,10 @@ def compute_quorum_results(
             participants[i].address for i in striped_sources
         ],
         all_recover_dst_replica_ranks=recover_dst,
+        spare_replica_ids=spare_ids,
+        all_manager_addresses=(
+            [p.address for p in participants] if spare_ids else []
+        ),
     )
 
 
@@ -175,6 +233,8 @@ class ManagerServer:
         quorum_retries: int = 0,
         kill_fn: Optional[Callable[[str], None]] = None,
         health_fn: Optional[Callable[[], Optional[object]]] = None,
+        role: int = ROLE_ACTIVE,
+        warm_fn: Optional[Callable[[], Optional[object]]] = None,
     ) -> None:
         self._replica_id = replica_id
         self._lighthouse_addr = lighthouse_addr
@@ -190,10 +250,38 @@ class ManagerServer:
         # detection input.  Errors are swallowed: a broken probe must never
         # kill the heartbeat that keeps this replica in the quorum.
         self._health_fn = health_fn
+        # quorum role (wire v3): SPARE registers as a hot spare that never
+        # counts toward membership; flipped to ACTIVE at promotion (read
+        # per quorum round — a plain attribute write is the handshake)
+        self.role = role
+        # warm-snapshot provider for spare warm fetches: returns the
+        # currently staged ``(step, PytreePlan)`` or None.  Served via
+        # MGR_WARM_INDEX/MGR_WARM_RANGE entirely OUTSIDE the heal path so a
+        # warming spare can never clobber (or block on) a real recovery.
+        self._warm_fn = warm_fn
+        # foreground-busy probe (idle-priority warm serving): when set and
+        # True, warm-range responses briefly yield so spare traffic never
+        # contends with a live collective on the NIC
+        self.busy_fn: Optional[Callable[[], bool]] = None
+        # per-chunk crc table of the staged warm plan, cached by plan
+        # identity (one full materialization pass per restage, not per
+        # request) — the version watermarks spares diff against
+        self._warm_hash_cache: Tuple[Optional[object], List[int]] = (None, [])
+        # outer-sync delta feed: committed (step, fragment, payload) blobs
+        # spares subscribe to (bounded ring; identical bytes on every
+        # replica by construction, so any one publisher suffices)
+        self._deltas: List[Tuple[int, int, bytes]] = []
+        self._deltas_bytes = 0
         # chaos hook (Failure.PARTITION): a partitioned replica loses its
         # control plane too, so the drill pauses heartbeats alongside the
         # data-plane partition mask
         self.heartbeat_paused = False
+        # lighthouse-restart detection: bumped by the heartbeat loop when a
+        # beat SUCCEEDS after failures (the lighthouse came back); the
+        # parked quorum forwarding call is interrupted so it re-registers
+        # against the fresh lighthouse instead of wedging on a dead socket
+        # until the quorum timeout
+        self._lh_restart_gen = 0
 
         self._lock = threading.Condition()
         # quorum barrier state
@@ -265,6 +353,7 @@ class ManagerServer:
     def _run_heartbeat(self) -> None:
         """Heartbeat the lighthouse until shutdown (``src/manager.rs:194-216``)."""
         client: Optional[LighthouseClient] = None
+        beat_failures = 0
         while not self._shutdown:
             if self.heartbeat_paused:
                 time.sleep(self._heartbeat_interval)
@@ -281,7 +370,17 @@ class ManagerServer:
                         self._lighthouse_addr, connect_timeout=self._connect_timeout
                     )
                 client.heartbeat(self._replica_id, health=health)
+                if beat_failures:
+                    # the lighthouse answered after failing: it (likely)
+                    # restarted with empty soft state.  A quorum RPC parked
+                    # against the DEAD incarnation would wedge until its
+                    # timeout; interrupt it so it re-registers (idempotent)
+                    # against the fresh lighthouse immediately.
+                    beat_failures = 0
+                    self._lh_restart_gen += 1
+                    self._interrupt_lh_quorum()
             except (OSError, TimeoutError, WireError) as e:
+                beat_failures += 1
                 logger.info(
                     "[Replica %s] failed to send heartbeat to lighthouse: %s",
                     self._replica_id,
@@ -293,6 +392,17 @@ class ManagerServer:
             time.sleep(self._heartbeat_interval)
         if client is not None:
             client.close()
+
+    def _interrupt_lh_quorum(self) -> None:
+        """Sever the persistent quorum-forwarding connection WITHOUT taking
+        its rpc lock (the parked call holds it): the blocked recv errors
+        out and ``_run_quorum`` retries against the restarted lighthouse."""
+        client = self._lh_quorum_client
+        if client is not None:
+            try:
+                client.interrupt()
+            except OSError:  # pragma: no cover — already torn down
+                pass
 
     # -- connection handling ------------------------------------------------
 
@@ -330,6 +440,12 @@ class ManagerServer:
                         )
                 elif msg_type == MsgType.MGR_SHOULD_COMMIT_REQ:
                     self._handle_should_commit(conn, r)
+                elif msg_type == MsgType.MGR_WARM_INDEX_REQ:
+                    self._handle_warm_index(conn)
+                elif msg_type == MsgType.MGR_WARM_RANGE_REQ:
+                    self._handle_warm_range(conn, r)
+                elif msg_type == MsgType.MGR_DELTA_REQ:
+                    self._handle_deltas(conn, r)
                 elif msg_type == MsgType.MGR_KILL_REQ:
                     msg = r.string()
                     send_frame(conn, MsgType.MGR_KILL_RESP)
@@ -343,6 +459,173 @@ class ManagerServer:
                 conn.close()
             except OSError:
                 pass
+
+    # -- spare warm channels ------------------------------------------------
+
+    def publish_delta(self, step: int, frag: int, payload: bytes) -> None:
+        """Append one committed outer-sync delta to the feed ring.  The
+        bytes are identical on every replica by construction (the sharded
+        outer sync allgathers one wire-format delta), so any single
+        publisher keeps every subscribed spare's shadow bit-exact."""
+        if len(payload) > _WARM_RANGE_MAX_BYTES:
+            # a too-big entry can never ride a wire frame: serving it
+            # would fail the spare's recv on EVERY poll (the cursor never
+            # advances past it), permanently killing the feed.  Refuse it
+            # here — the spare's shadow demotes to chunk-store warming,
+            # which chunks arbitrarily large state.
+            logger.warning(
+                "[Replica %s] outer delta (step %d frag %d, %d bytes) "
+                "exceeds the frame budget; dropped — spares warm via "
+                "snapshot chunks instead",
+                self._replica_id,
+                step,
+                frag,
+                len(payload),
+            )
+            return
+        with self._lock:
+            self._deltas.append((step, frag, payload))
+            self._deltas_bytes += len(payload)
+            cap = _spare_delta_buf_bytes()
+            while self._deltas and (
+                self._deltas_bytes > cap
+                or len(self._deltas) > _SPARE_DELTA_MAX_ENTRIES
+            ):
+                _s, _f, old = self._deltas.pop(0)
+                self._deltas_bytes -= len(old)
+
+    def _handle_deltas(self, conn: socket.socket, r: Reader) -> None:
+        """Serve feed entries strictly newer than the subscriber's
+        ``(step, frag)`` cursor, oldest first, capped to one frame."""
+        after_step = r.i64()
+        after_frag = r.i64()
+        with self._lock:
+            fresh = [
+                e for e in self._deltas if (e[0], e[1]) > (after_step, after_frag)
+            ]
+        w = Writer()
+        picked: List[Tuple[int, int, bytes]] = []
+        budget = _WARM_RANGE_MAX_BYTES
+        for step, frag, payload in fresh:
+            if picked and budget - len(payload) < 0:
+                break
+            picked.append((step, frag, payload))
+            budget -= len(payload)
+        w.u32(len(picked))
+        for step, frag, payload in picked:
+            w.i64(step).i64(frag).blob(payload)
+        send_frame(conn, MsgType.MGR_DELTA_RESP, w.payload())
+
+    def _warm_plan(self):
+        if self._warm_fn is None:
+            return None
+        try:
+            return self._warm_fn()
+        except Exception:  # noqa: BLE001 — a broken probe must not kill
+            # the connection loop; the spare just sees "nothing staged"
+            logger.exception(
+                "[Replica %s] warm snapshot provider failed", self._replica_id
+            )
+            return None
+
+    def _warm_chunk_hashes(self, plan) -> List[int]:
+        """crc32 per warm chunk (array-payload granularity — chunk keys
+        are STABLE across steps for a fixed tree structure, unlike
+        serialized-stream offsets whose pickled header length can drift).
+        These are the per-chunk version watermarks: a spare refetches only
+        chunks whose crc moved since its last pass."""
+        cached_plan, cached = self._warm_hash_cache
+        if cached_plan is plan:
+            return cached
+        import zlib
+
+        from torchft_tpu.checkpointing.serialization import (
+            array_chunk_ranges,
+            as_byte_view,
+            heal_chunk_bytes,
+        )
+
+        hashes = []
+        for ai, lo, hi in array_chunk_ranges(
+            plan.leaf_nbytes, heal_chunk_bytes()
+        ):
+            view = as_byte_view(plan._materialize(ai))[lo:hi]
+            hashes.append(zlib.crc32(view))
+        self._warm_hash_cache = (plan, hashes)
+        return hashes
+
+    def _handle_warm_index(self, conn: socket.socket) -> None:
+        staged = self._warm_plan()
+        if staged is None:
+            send_error(conn, ErrCode.NOT_FOUND, "no warm snapshot staged")
+            return
+        step, plan = staged
+        from torchft_tpu.checkpointing.serialization import heal_chunk_bytes
+
+        hashes = self._warm_chunk_hashes(plan)
+        w = Writer()
+        w.i64(step)
+        w.u64(plan.total_len)
+        w.u64(len(plan.header))
+        w.string(plan.header_digest())
+        w.u32(len(plan.leaf_nbytes))
+        for n in plan.leaf_nbytes:
+            w.u64(n)
+        w.u64(heal_chunk_bytes())
+        w.u32(len(hashes))
+        for h in hashes:
+            w.u32(h)
+        send_frame(conn, MsgType.MGR_WARM_INDEX_RESP, w.payload())
+
+    def _handle_warm_range(self, conn: socket.socket, r: Reader) -> None:
+        """Serve bytes [start, stop) of the warm snapshot staged at exactly
+        ``step`` — a moved snapshot is NOT served (the spare's watermark
+        protocol re-fetches the index rather than trusting a stale range).
+        Idle priority: yields briefly while foreground collectives run."""
+        step = r.i64()
+        start = r.u64()
+        stop = r.u64()
+        staged = self._warm_plan()
+        if staged is None or staged[0] != step:
+            send_error(
+                conn,
+                ErrCode.NOT_FOUND,
+                f"warm snapshot at step {step} no longer staged",
+            )
+            return
+        _step, plan = staged
+        if not 0 <= start <= stop <= plan.total_len:
+            send_error(
+                conn,
+                ErrCode.INVALID,
+                f"bad warm range [{start}, {stop}) of {plan.total_len}",
+            )
+            return
+        if stop - start > _WARM_RANGE_MAX_BYTES:
+            send_error(
+                conn,
+                ErrCode.INVALID,
+                f"warm range too large ({stop - start} bytes)",
+            )
+            return
+        if self.busy_fn is not None:
+            yield_deadline = time.monotonic() + _WARM_YIELD_S
+            while time.monotonic() < yield_deadline:
+                try:
+                    if not self.busy_fn():
+                        break
+                except Exception:  # noqa: BLE001 — probe must not block serving
+                    break
+                time.sleep(0.01)
+        import io
+
+        buf = io.BytesIO()
+        plan.write_range(start, stop, buf)
+        send_frame(
+            conn,
+            MsgType.MGR_WARM_RANGE_RESP,
+            Writer().i64(step).blob(buf.getvalue()).payload(),
+        )
 
     # -- quorum barrier -----------------------------------------------------
 
@@ -370,6 +653,7 @@ class ManagerServer:
                 world_size=self._world_size,
                 shrink_only=shrink_only,
                 commit_failures=commit_failures,
+                role=self.role,
             )
             self._participants[group_rank] = member
             gen = self._quorum_gen
@@ -440,13 +724,16 @@ class ManagerServer:
         )
         quorum: Optional[Quorum] = None
         last_err = "unknown"
-        for attempt in range(self._quorum_retries + 1):
+        deadline = time.monotonic() + timeout_s
+        attempt = 0
+        while attempt <= self._quorum_retries:
             if self.heartbeat_paused:
                 # chaos partition: the control plane is severed — a quorum
                 # rpc is an implicit lighthouse heartbeat, so forwarding it
                 # would keep this "partitioned" replica looking alive
                 last_err = "control plane severed (chaos partition)"
                 break
+            restart_gen = self._lh_restart_gen
             try:
               with self._lh_client_lock:
                 # persistent connection across rounds (the reference keeps a
@@ -457,13 +744,14 @@ class ManagerServer:
                     )
                 quorum = self._lh_quorum_client.quorum(
                     replica_id=requester.replica_id,
-                    timeout=timeout_s,
+                    timeout=max(0.1, deadline - time.monotonic()),
                     address=requester.address,
                     store_address=requester.store_address,
                     step=requester.step,
                     world_size=requester.world_size,
                     shrink_only=requester.shrink_only,
                     commit_failures=requester.commit_failures,
+                    role=self.role,
                 )
                 break
             except (OSError, TimeoutError, WireError) as e:
@@ -477,7 +765,22 @@ class ManagerServer:
                 if self._lh_quorum_client is not None:
                     self._lh_quorum_client.close()
                     self._lh_quorum_client = None
-                if attempt < self._quorum_retries:
+                if (
+                    self._lh_restart_gen != restart_gen
+                    and time.monotonic() < deadline
+                    and not self._shutdown
+                ):
+                    # the heartbeat loop detected a lighthouse restart and
+                    # interrupted this (now moot) parked call: re-register
+                    # against the fresh lighthouse at once.  Registration is
+                    # idempotent server-side and this retry is FREE (not
+                    # counted against quorum_retries) — bounded only by the
+                    # caller's deadline — so a default retries=0 fleet still
+                    # rides out a lighthouse bounce instead of wedging until
+                    # the quorum timeout.
+                    continue
+                attempt += 1
+                if attempt <= self._quorum_retries:
                     # only back off when another attempt remains — otherwise
                     # broadcast the failure to parked ranks immediately
                     time.sleep(
@@ -614,3 +917,43 @@ class ManagerClient(RpcClient):
     def kill(self, msg: str, timeout: float = 10.0) -> None:
         msg_type, r = self._call(MsgType.MGR_KILL_REQ, Writer().string(msg).payload(), timeout)
         raise_if_error(msg_type, r)
+
+    # -- spare warm channels ------------------------------------------------
+
+    def warm_index(self, timeout: float = 10.0) -> Dict[str, object]:
+        """Chunk-addressable index of the peer's staged warm snapshot:
+        ``{"step", "total_len", "header_len", "header_digest",
+        "leaf_nbytes"}``.  Raises WireError(NOT_FOUND) when nothing is
+        staged (the peer has no spares to feed, or just committed)."""
+        msg_type, r = self._call(MsgType.MGR_WARM_INDEX_REQ, b"", timeout)
+        raise_if_error(msg_type, r)
+        return {
+            "step": r.i64(),
+            "total_len": r.u64(),
+            "header_len": r.u64(),
+            "header_digest": r.string(),
+            "leaf_nbytes": [r.u64() for _ in range(r.u32())],
+            "chunk_target_bytes": r.u64(),
+            "chunk_hashes": [r.u32() for _ in range(r.u32())],
+        }
+
+    def warm_range(
+        self, step: int, start: int, stop: int, timeout: float = 30.0
+    ) -> bytes:
+        """Bytes [start, stop) of the warm snapshot staged at ``step``;
+        NOT_FOUND when the snapshot moved (refetch the index)."""
+        w = Writer().i64(step).u64(start).u64(stop)
+        msg_type, r = self._call(MsgType.MGR_WARM_RANGE_REQ, w.payload(), timeout)
+        raise_if_error(msg_type, r)
+        r.i64()  # echoed step
+        return r.blob()
+
+    def deltas(
+        self, after_step: int, after_frag: int, timeout: float = 10.0
+    ) -> List[Tuple[int, int, bytes]]:
+        """Outer-sync delta feed entries strictly newer than the
+        ``(after_step, after_frag)`` cursor, oldest first."""
+        w = Writer().i64(after_step).i64(after_frag)
+        msg_type, r = self._call(MsgType.MGR_DELTA_REQ, w.payload(), timeout)
+        raise_if_error(msg_type, r)
+        return [(r.i64(), r.i64(), r.blob()) for _ in range(r.u32())]

@@ -422,17 +422,28 @@ class CppManagerServer:
         connect_timeout: float = 10.0,
         quorum_retries: int = 0,
         health_fn: Optional[object] = None,
+        role: int = 0,
+        warm_fn: Optional[object] = None,
     ) -> None:
         import socket
 
         # health_fn (comm-health heartbeat summaries for straggler
         # detection) is accepted for construction parity with the Python
         # ManagerServer but unused: the C++ sidecar sends legacy
-        # heartbeats, which the lighthouse treats as "no health report"
-        del health_fn
+        # heartbeats, which the lighthouse treats as "no health report".
+        # warm_fn (spare warm-snapshot serving) likewise: the C++ sidecar
+        # cannot host a spare or feed one — spare roles require the Python
+        # tier (Manager(role="spare") refuses a native server_cls).
+        del health_fn, warm_fn
+        if role != 0:
+            raise ValueError(
+                "CppManagerServer does not support the SPARE role; use the "
+                "Python tier for spare replicas"
+            )
         lib = _load()
         assert lib is not None, "native runtime unavailable"
         self._lib = lib
+        self.role = role  # attribute parity with ManagerServer
         self._hostname = hostname or socket.gethostname()
         self._h = lib.tpuft_manager_new(
             replica_id.encode(),

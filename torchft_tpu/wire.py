@@ -59,12 +59,27 @@ _CONNECT_BACKOFF_BASE_S = 0.1
 # Wire version of the MGR_QUORUM_RESP body.  v1 is the original fixed field
 # order; v2 appends the striped-healing fields (every healthy peer's replica
 # rank + manager address, and the full recovery-destination set) AFTER the v1
-# fields, prefixed by this version number.  v1 decoders ignore trailing
-# bytes and v2 decoders treat their absence as "no striping info", so mixed
-# fleets interoperate during a rolling upgrade; pin TORCHFT_WIRE_COMPAT=1 on
-# upgraded servers until every client understands v2.
-MANAGER_QUORUM_WIRE_VERSION = 2
+# fields, prefixed by this version number.  v3 adds the spare-replica fields
+# (is_spare, registered spare ids, participant manager addresses) in the
+# same tail.  v1 decoders ignore trailing bytes and v2/v3 decoders treat
+# their absence as "no striping/spare info", so mixed fleets interoperate
+# during a rolling upgrade; pin TORCHFT_WIRE_COMPAT=1 (or 2) on upgraded
+# servers until every client understands the newer version.  The v3 spare
+# fields are additionally emitted only when spare content EXISTS, so a
+# spare-free fleet stays byte-for-byte on the v2 layout.
+MANAGER_QUORUM_WIRE_VERSION = 3
 WIRE_COMPAT_ENV = "TORCHFT_WIRE_COMPAT"
+
+# QuorumMember roles (wire v3).  ACTIVE members count toward min_replicas /
+# majority and run collectives; SPARE members pre-join the control plane and
+# keep a warm shadow of the fleet state but contribute nothing until the
+# lighthouse promotes them.  The role rides as a version-gated TAIL byte on
+# LH_QUORUM_REQ (after timeout_ms) and the spare list as a tail on the
+# Quorum broadcast — legacy decoders ignore trailing bytes, and the tails
+# are emitted only when a spare is actually involved, so role-free fleets
+# stay byte-identical to v2.
+ROLE_ACTIVE = 0
+ROLE_SPARE = 1
 
 
 def manager_quorum_wire_version() -> int:
@@ -107,6 +122,15 @@ class MsgType(IntEnum):
     MGR_SHOULD_COMMIT_RESP = 0x25
     MGR_KILL_REQ = 0x26
     MGR_KILL_RESP = 0x27
+    # Spare warm channels (manager_server.py): chunk-addressable snapshot
+    # index + ranges (per-chunk version watermarks ride the staged step),
+    # and the outer-sync delta feed spares subscribe to.
+    MGR_WARM_INDEX_REQ = 0x28
+    MGR_WARM_INDEX_RESP = 0x29
+    MGR_WARM_RANGE_REQ = 0x2A
+    MGR_WARM_RANGE_RESP = 0x2B
+    MGR_DELTA_REQ = 0x2C
+    MGR_DELTA_RESP = 0x2D
     # Communicator data plane (communicator.py)
     COMM_HELLO = 0x30
     COMM_DATA = 0x31
@@ -253,6 +277,10 @@ class QuorumMember:
     shrink_only: bool = False
     commit_failures: int = 0
     data: str = ""
+    # NOT part of the fixed encode layout (legacy compatibility): the role
+    # rides as a version-gated tail on the messages that carry members —
+    # see ROLE_ACTIVE/ROLE_SPARE above.
+    role: int = ROLE_ACTIVE
 
     def encode(self, w: Writer) -> None:
         (
@@ -323,27 +351,45 @@ class CommHealth:
 
 @dataclass
 class Quorum:
-    """A computed quorum (``proto/torchft.proto`` ``Quorum`` message)."""
+    """A computed quorum (``proto/torchft.proto`` ``Quorum`` message).
+
+    ``spares`` (wire v3) rides as a version-gated tail AFTER the
+    participant list: registered spare replicas that pre-joined the control
+    plane but are NOT participants — they never count toward membership,
+    never affect ``quorum_id``, and a v1/v2 decoder never sees them (it
+    stops after the participants).  The tail is emitted only when spares
+    exist, so spare-free quorums stay byte-identical to v2."""
 
     quorum_id: int
     participants: List[QuorumMember] = field(default_factory=list)
     created: float = 0.0  # unix seconds
+    spares: List[QuorumMember] = field(default_factory=list)
 
     def encode(self, w: Writer) -> None:
         w.i64(self.quorum_id).f64(self.created).u32(len(self.participants))
         for p in self.participants:
             p.encode(w)
+        if self.spares and manager_quorum_wire_version() >= 3:
+            w.u32(3)
+            w.u32(len(self.spares))
+            for s in self.spares:
+                s.encode(w)
 
     @staticmethod
     def decode(r: Reader) -> "Quorum":
         quorum_id = r.i64()
         created = r.f64()
         n = r.u32()
-        return Quorum(
+        out = Quorum(
             quorum_id=quorum_id,
             created=created,
             participants=[QuorumMember.decode(r) for _ in range(n)],
         )
+        if not r.done() and r.u32() >= 3:
+            out.spares = [QuorumMember.decode(r) for _ in range(r.u32())]
+            for s in out.spares:
+                s.role = ROLE_SPARE
+        return out
 
 
 @dataclass
@@ -381,6 +427,17 @@ class ManagerQuorumResult:
     # assignments) so EVERY healthy peer — not just the round-robin primary —
     # stages/serves its checkpoint for a striped heal.
     all_recover_dst_replica_ranks: List[int] = field(default_factory=list)
+    # -- v3 (hot spares) -----------------------------------------------------
+    # True when THIS replica is a registered spare of the quorum (not a
+    # participant): it must warm, not train.  ``spare_replica_ids`` lists
+    # the registered spares (actives use it to keep a warm snapshot
+    # staged); ``all_manager_addresses`` aligns with ``replica_ids`` so a
+    # spare can reach every participant's manager for warm fetches and the
+    # outer-delta feed.  Emitted only when spare content exists — a
+    # spare-free fleet stays byte-for-byte on the v2 layout.
+    is_spare: bool = False
+    spare_replica_ids: List[str] = field(default_factory=list)
+    all_manager_addresses: List[str] = field(default_factory=list)
 
     def heal_sources(self) -> List[Tuple[int, str]]:
         """(replica_rank, manager_address) of every peer able to serve this
@@ -412,8 +469,12 @@ class ManagerQuorumResult:
         w.u32(len(self.replica_ids))
         for rid in self.replica_ids:
             w.string(rid)
-        if manager_quorum_wire_version() >= 2:
-            w.u32(2)
+        wire_version = manager_quorum_wire_version()
+        has_spare_tail = wire_version >= 3 and (
+            self.is_spare or self.spare_replica_ids
+        )
+        if wire_version >= 2:
+            w.u32(3 if has_spare_tail else 2)
             w.u32(len(self.recover_src_replica_ranks))
             for rank in self.recover_src_replica_ranks:
                 w.i64(rank)
@@ -423,6 +484,14 @@ class ManagerQuorumResult:
             w.u32(len(self.all_recover_dst_replica_ranks))
             for rank in self.all_recover_dst_replica_ranks:
                 w.i64(rank)
+        if has_spare_tail:
+            w.boolean(self.is_spare)
+            w.u32(len(self.spare_replica_ids))
+            for rid in self.spare_replica_ids:
+                w.string(rid)
+            w.u32(len(self.all_manager_addresses))
+            for addr in self.all_manager_addresses:
+                w.string(addr)
 
     @staticmethod
     def decode(r: Reader) -> "ManagerQuorumResult":
@@ -440,12 +509,24 @@ class ManagerQuorumResult:
         out.heal = r.boolean()
         out.commit_failures = r.i64()
         out.replica_ids = [r.string() for _ in range(r.u32())]
-        if not r.done() and r.u32() >= 2:
-            out.recover_src_replica_ranks = [r.i64() for _ in range(r.u32())]
-            out.recover_src_manager_addresses = [
-                r.string() for _ in range(r.u32())
-            ]
-            out.all_recover_dst_replica_ranks = [r.i64() for _ in range(r.u32())]
+        if not r.done():
+            tail_version = r.u32()
+            if tail_version >= 2:
+                out.recover_src_replica_ranks = [
+                    r.i64() for _ in range(r.u32())
+                ]
+                out.recover_src_manager_addresses = [
+                    r.string() for _ in range(r.u32())
+                ]
+                out.all_recover_dst_replica_ranks = [
+                    r.i64() for _ in range(r.u32())
+                ]
+            if tail_version >= 3:
+                out.is_spare = r.boolean()
+                out.spare_replica_ids = [r.string() for _ in range(r.u32())]
+                out.all_manager_addresses = [
+                    r.string() for _ in range(r.u32())
+                ]
         return out
 
 
@@ -673,6 +754,23 @@ class RpcClient:
                     if attempt + 1 >= attempts:
                         raise
             raise AssertionError("unreachable")  # pragma: no cover
+
+    def interrupt(self) -> None:
+        """Sever the live socket WITHOUT taking the rpc lock: a call parked
+        in recv on another thread errors out immediately instead of waiting
+        its full deadline.  Used when the caller KNOWS the server went away
+        and came back (e.g. a lighthouse restart detected by the heartbeat
+        loop); the interrupted call's error path drops and re-dials."""
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def close(self) -> None:
         with self._lock:
