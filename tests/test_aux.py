@@ -3,8 +3,6 @@ parameter server, coordination exports."""
 
 import json
 import logging
-import os
-import subprocess
 import sys
 import time
 

@@ -2,7 +2,6 @@
 (reference analog: BabyGloo/BabyNCCL conformance + resiliency,
 ``process_group_test.py:952-1027``)."""
 
-import threading
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np

@@ -22,9 +22,7 @@ replica frozen, including its manager's heartbeat) is covered against real
 
 from __future__ import annotations
 
-import os
 import re
-import signal
 import subprocess
 import sys
 import threading

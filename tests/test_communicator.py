@@ -17,7 +17,6 @@ import pytest
 
 from torchft_tpu.communicator import (
     CommunicatorAborted,
-    CommunicatorError,
     DummyCommunicator,
     FakeCommunicatorWrapper,
     ReduceOp,

@@ -4,7 +4,6 @@ import os
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from torchft_tpu.utils.checkpoint import (
     latest_step,

@@ -5,7 +5,6 @@ validated on TPU v5 (fwd max-abs-diff 9e-7 vs the f32 naive path, grads
 ~1.5e-4; benchmarks/RESULTS.md records the speedups).
 """
 
-import os
 
 import jax
 import jax.numpy as jnp

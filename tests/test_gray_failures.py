@@ -12,7 +12,6 @@ replica is flagged from heartbeat comm-health and (behind
 
 import random
 import socket
-import struct
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -26,7 +25,6 @@ from torchft_tpu.communicator import (
     CommunicatorError,
     ReduceOp,
     TCPCommunicator,
-    _FaultProgram,
     _recv_exact,
     parse_fault_spec,
 )

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from torchft_tpu.communicator import DummyCommunicator
 from torchft_tpu.manager import Manager
@@ -23,8 +23,7 @@ from torchft_tpu.parallel.hsdp import (
     make_grad_step,
     shard_init,
 )
-from torchft_tpu.parallel.mesh import MeshAxes, make_mesh
-from torchft_tpu.parallel.ring_attention import ring_attention_sharded
+from torchft_tpu.parallel.mesh import make_mesh
 
 from tests.test_manager import MemoryTransport, StubClient, _quorum_result
 

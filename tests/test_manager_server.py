@@ -23,7 +23,6 @@ from torchft_tpu.wire import (
     MsgType,
     Quorum,
     QuorumMember,
-    Reader,
     WireError,
     Writer,
     recv_frame,

@@ -3,10 +3,8 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from torchft_tpu.parallel.mesh import make_mesh
 from torchft_tpu.parallel.moe import MoE, MoEConfig
 
 

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from torchft_tpu import native
-from torchft_tpu.communicator import CommunicatorError, ReduceOp
+from torchft_tpu.communicator import ReduceOp
 from torchft_tpu.lighthouse import LighthouseClient
 from torchft_tpu.manager_server import ManagerClient
 from torchft_tpu.store import StoreClient

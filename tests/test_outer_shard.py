@@ -21,8 +21,6 @@ The tentpole contract of the sharded outer sync
   ride shm and move zero socket bytes.
 """
 
-import threading
-import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional
 

@@ -5,7 +5,6 @@ fixtures, and the full device-quantized gradient path)."""
 import json
 import os
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

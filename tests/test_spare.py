@@ -12,7 +12,6 @@ the fleet — so an active replica's death costs a membership edit, not a
 the active fleet.
 """
 
-import os
 import threading
 import time
 

@@ -3,9 +3,7 @@ quorum fields, multi-source fetch/reassembly over both transports, and
 mid-heal source death (chaos) with work-stealing failover."""
 
 import io
-import threading
-import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 import pytest

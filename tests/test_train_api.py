@@ -6,7 +6,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from torchft_tpu.communicator import DummyCommunicator
 from torchft_tpu.data import DistributedSampler, batch_indices

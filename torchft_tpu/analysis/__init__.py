@@ -1,0 +1,43 @@
+"""``ftlint`` — repo-specific static analysis for the torchft_tpu stack.
+
+Four AST/text checkers enforce the invariants that keep a heavily
+concurrent fault-tolerance control plane coherent, the ones the bug record
+shows reviewers keep having to catch by hand:
+
+- ``thread-safety`` (:mod:`.threads`): builds a thread-entry graph per
+  class (``threading.Thread`` targets, executor ``submit`` targets, and
+  everything transitively reachable — RPC handlers ride the accept-loop's
+  reachability) and flags read-modify-write mutations of ``self.*`` state
+  reachable from two or more entry points that are not lexically under a
+  ``with <lock>`` — the ``_inflight_ops +=`` bug class, found statically.
+- ``wire-protocol`` (:mod:`.wireproto`): every data-plane tag literal must
+  come from the central registry in ``wire.py`` (no more scattered 103 /
+  880 / 900 / 4000... constants), registered allocations must not collide,
+  and every ``encode``/``decode`` pair in ``wire.py`` must be symmetric
+  per wire-version gate — a field serialized under
+  ``manager_quorum_wire_version() >= N`` must be parsed under the same
+  guard, so a one-sided tail can never silently desync rolling upgrades.
+- ``knob-registry`` (:mod:`.knobcheck`): every ``TORCHFT_*`` / ``TPUFT_*``
+  environment knob mentioned in source must be declared in
+  ``torchft_tpu/knobs.py``, and the knob table in ``docs/operations.md``
+  must agree with the registry in both directions.
+- ``native-mirror`` (:mod:`.nativemirror`): the hand-mirrored constants
+  shared with the C++ tier (``native/comm.h`` / ``native/wire.h`` — lane
+  hello flag, 64-byte stripe alignment, frame cap, message types, the
+  ``lane_parts`` / ``outer_shard_parts`` / ``HostTopology`` mirrors) must
+  match their Python counterparts so the tiers can't drift apart silently.
+
+Run ``python -m torchft_tpu.analysis`` from the repo root (CI does).  A
+finding is suppressed either by an inline pragma on its line —
+``# ftlint: ignore[<checker>] — <why>`` — or by a fingerprint in
+``torchft_tpu/analysis/baseline.json`` (grandfathered violations only;
+keep it near-empty).  See ``docs/analysis.md``.
+"""
+
+from torchft_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    load_baseline,
+    run_checkers,
+)
+
+CHECKERS = ("thread-safety", "wire-protocol", "knob-registry", "native-mirror")

@@ -1,0 +1,79 @@
+"""``ftlint`` CLI: ``python -m torchft_tpu.analysis [options]``.
+
+Exit status 0 when every finding is pragma-suppressed or baselined,
+1 otherwise (CI gates on this).  ``--write-baseline`` grandfathers the
+current findings; keep that list near-empty and justified (see
+docs/analysis.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from torchft_tpu.analysis import CHECKERS
+from torchft_tpu.analysis.core import (
+    default_baseline_path,
+    repo_root,
+    run_checkers,
+    save_baseline,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ftlint", description="torchft_tpu repo-specific static analysis"
+    )
+    parser.add_argument("--root", default=None, help="repo root (default: auto)")
+    parser.add_argument(
+        "--checker",
+        action="append",
+        choices=CHECKERS,
+        help="run only this checker (repeatable; default: all)",
+    )
+    parser.add_argument(
+        "--baseline", default=None, help="baseline path (default: in-package)"
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="grandfather every current finding into the baseline",
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true", help="findings only, no summary"
+    )
+    args = parser.parse_args(argv)
+
+    root = args.root or repo_root()
+    baseline_path = args.baseline or default_baseline_path(root)
+    result = run_checkers(
+        root=root, checkers=args.checker, baseline_path=baseline_path
+    )
+
+    if args.write_baseline:
+        # keep still-firing grandfathered entries; only stale ones drop
+        keep = result.new + result.baselined
+        save_baseline(baseline_path, keep)
+        print(f"ftlint: wrote {len(keep)} suppressions to {baseline_path}")
+        return 0
+
+    for finding in sorted(result.new, key=lambda f: (f.file, f.line)):
+        print(finding.render())
+    if not args.quiet:
+        parts = [f"{len(result.new)} finding(s)"]
+        if result.suppressed:
+            parts.append(f"{len(result.suppressed)} pragma-suppressed")
+        if result.baselined:
+            parts.append(f"{len(result.baselined)} baselined")
+        print(f"ftlint: {', '.join(parts)}", file=sys.stderr)
+        for fp in result.stale_baseline:
+            print(
+                f"ftlint: warning: stale baseline entry {fp} (no longer "
+                f"produced — remove it)",
+                file=sys.stderr,
+            )
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""Native-mirror checker: the C++ tier's hand-mirrored constants.
+
+``native/comm.h`` and ``native/wire.h`` deliberately re-implement the
+Python tier's wire math (``lane_parts``, ``outer_shard_parts``,
+``HostTopology``, the lane-hello flag, the 64-byte stripe alignment, the
+frame cap, the message-type enums) so the two tiers stay byte-compatible
+on the wire.  Nothing enforces the mirror — this checker does, by parsing
+the headers textually (no C++ toolchain needed at lint time) and comparing
+every shared constant against its live Python counterpart:
+
+- ``kMaxFrameBytes``        == ``wire.MAX_FRAME_BYTES``
+- ``MsgType`` / ``ErrCode`` values (every native entry must exist in
+  Python under the same value; ``ERROR_FRAME`` maps to ``ERROR``)
+- ``kLaneHelloFlag``        == ``communicator._LANE_HELLO_FLAG``
+- stripe alignment: ``lane_parts``'s ``/ 64 * 64`` cut and
+  ``outer_shard_parts``'s ``unit % 64`` / ``unit = 64`` default
+  == ``communicator._STRIPE_ALIGN``
+- default stripe floor (``stripe_floor_from_env``)
+  == ``communicator._MIN_STRIPE_BYTES``
+- the ``outer_shard_parts`` padding formula matches the canonical
+  ceil-to-unit form, and mirrored symbols (``HostTopology`` with its
+  ``worth_it`` auto criterion, ``lane_parts``, ``outer_shard_parts``)
+  exist at all.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+from torchft_tpu.analysis.core import Finding
+
+CHECKER = "native-mirror"
+
+_COMM_H = os.path.join("native", "comm.h")
+_WIRE_H = os.path.join("native", "wire.h")
+
+
+def _finding(rel: str, line: int, symbol: str, message: str) -> Finding:
+    return Finding(
+        checker=CHECKER, file=rel, line=line, symbol=symbol, message=message
+    )
+
+
+def _line_of(text: str, pattern: str) -> int:
+    m = re.search(pattern, text)
+    return text[: m.start()].count("\n") + 1 if m else 1
+
+
+def check_wire_header(text: str, rel: str = _WIRE_H) -> List[Finding]:
+    from torchft_tpu import wire as pywire
+
+    findings: List[Finding] = []
+
+    m = re.search(r"kMaxFrameBytes\s*=\s*(\d+)ull\s*\*\s*1024\s*\*\s*1024", text)
+    if not m:
+        findings.append(
+            _finding(rel, 1, "kMaxFrameBytes", "kMaxFrameBytes not found in wire.h")
+        )
+    elif int(m.group(1)) * 1024 * 1024 != pywire.MAX_FRAME_BYTES:
+        findings.append(
+            _finding(
+                rel,
+                _line_of(text, r"kMaxFrameBytes"),
+                "kMaxFrameBytes",
+                f"kMaxFrameBytes = {int(m.group(1))} MiB but Python "
+                f"wire.MAX_FRAME_BYTES = {pywire.MAX_FRAME_BYTES} bytes",
+            )
+        )
+
+    name_map = {"ERROR_FRAME": "ERROR"}
+    for cname, value_str in re.findall(
+        r"^\s*([A-Z][A-Z0-9_]+)\s*=\s*(0x[0-9A-Fa-f]+|\d+)\s*,", text, re.M
+    ):
+        value = int(value_str, 0)
+        if cname.startswith("ERR_"):
+            pyname = cname[len("ERR_"):]
+            table = {e.name: e.value for e in pywire.ErrCode}
+        else:
+            pyname = name_map.get(cname, cname)
+            table = {e.name: e.value for e in pywire.MsgType}
+        if pyname not in table:
+            findings.append(
+                _finding(
+                    rel,
+                    _line_of(text, re.escape(cname)),
+                    cname,
+                    f"native enum {cname} has no Python counterpart "
+                    f"({pyname} not in wire.MsgType/ErrCode)",
+                )
+            )
+        elif table[pyname] != value:
+            findings.append(
+                _finding(
+                    rel,
+                    _line_of(text, re.escape(cname)),
+                    cname,
+                    f"native {cname} = {value:#x} but Python "
+                    f"{pyname} = {table[pyname]:#x}",
+                )
+            )
+    return findings
+
+
+def check_comm_header(text: str, rel: str = _COMM_H) -> List[Finding]:
+    from torchft_tpu import communicator as pycomm
+
+    findings: List[Finding] = []
+
+    # mirrored symbols must exist at all
+    for symbol, pattern in (
+        ("HostTopology", r"struct\s+HostTopology"),
+        ("HostTopology.worth_it", r"bool\s+worth_it\s*\("),
+        ("lane_parts", r"\blane_parts\s*\("),
+        ("outer_shard_parts", r"\bouter_shard_parts\s*\("),
+        ("kLaneHelloFlag", r"kLaneHelloFlag"),
+    ):
+        if not re.search(pattern, text):
+            findings.append(
+                _finding(
+                    rel,
+                    1,
+                    symbol,
+                    f"mirrored symbol {symbol} not found in comm.h — the "
+                    f"native tier no longer mirrors the Python wire math",
+                )
+            )
+
+    # lane hello flag
+    m = re.search(r"kLaneHelloFlag\s*=\s*uint64_t\(1\)\s*<<\s*(\d+)", text)
+    if m and (1 << int(m.group(1))) != pycomm._LANE_HELLO_FLAG:
+        findings.append(
+            _finding(
+                rel,
+                _line_of(text, r"kLaneHelloFlag"),
+                "kLaneHelloFlag",
+                f"kLaneHelloFlag = 1<<{m.group(1)} but Python "
+                f"_LANE_HELLO_FLAG = {pycomm._LANE_HELLO_FLAG:#x}",
+            )
+        )
+
+    align = pycomm._STRIPE_ALIGN
+
+    # lane_parts 64-byte cut:  cut = (i * nbytes / k) / 64 * 64
+    m = re.search(r"\(i \* nbytes / k\)\s*/\s*(\d+)\s*\*\s*(\d+)", text)
+    if m and (int(m.group(1)) != align or int(m.group(2)) != align):
+        findings.append(
+            _finding(
+                rel,
+                _line_of(text, r"\(i \* nbytes / k\)"),
+                "lane_parts.align",
+                f"lane_parts aligns cuts to {m.group(1)} bytes but Python "
+                f"_STRIPE_ALIGN = {align}",
+            )
+        )
+
+    # outer_shard_parts: unit check + default + padding formula
+    m = re.search(r"unit\s*%\s*(\d+)\s*!=\s*0", text)
+    if m and int(m.group(1)) != align:
+        findings.append(
+            _finding(
+                rel,
+                _line_of(text, r"unit\s*%"),
+                "outer_shard_parts.unit",
+                f"outer_shard_parts requires unit %% {m.group(1)} == 0 but "
+                f"Python requires a multiple of {align}",
+            )
+        )
+    m = re.search(r"size_t\s+unit\s*=\s*(\d+)", text)
+    if m and int(m.group(1)) != align:
+        findings.append(
+            _finding(
+                rel,
+                _line_of(text, r"size_t\s+unit\s*="),
+                "outer_shard_parts.default_unit",
+                f"outer_shard_parts default unit = {m.group(1)} but Python "
+                f"default is _STRIPE_ALIGN = {align}",
+            )
+        )
+    if re.search(r"\bouter_shard_parts\s*\(", text) and not re.search(
+        r"share\s*=\s*\(nbytes \+ parts \* unit - 1\)\s*/\s*\(parts \* unit\)\s*\*\s*unit",
+        text,
+    ):
+        findings.append(
+            _finding(
+                rel,
+                _line_of(text, r"outer_shard_parts"),
+                "outer_shard_parts.formula",
+                "outer_shard_parts share formula drifted from the canonical "
+                "ceil(nbytes / (parts*unit)) * unit — Python "
+                "communicator.outer_shard_parts computes "
+                "-(-nbytes // (parts * unit)) * unit",
+            )
+        )
+
+    # default stripe floor
+    m = re.search(
+        r'== "auto"\)\s*return\s+size_t\((\d+)\)\s*<<\s*(\d+);', text
+    )
+    if m:
+        native_floor = int(m.group(1)) << int(m.group(2))
+        if native_floor != pycomm._MIN_STRIPE_BYTES:
+            findings.append(
+                _finding(
+                    rel,
+                    _line_of(text, r"stripe_floor_from_env"),
+                    "stripe_floor",
+                    f"native default stripe floor = {native_floor} but "
+                    f"Python _MIN_STRIPE_BYTES = {pycomm._MIN_STRIPE_BYTES}",
+                )
+            )
+    return findings
+
+
+def check(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, fn in ((_WIRE_H, check_wire_header), (_COMM_H, check_comm_header)):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            findings.append(
+                _finding(
+                    rel.replace(os.sep, "/"),
+                    1,
+                    "header",
+                    f"{rel} missing — cannot verify the native mirror",
+                )
+            )
+            continue
+        with open(path) as f:
+            findings.extend(fn(f.read(), rel.replace(os.sep, "/")))
+    return findings

@@ -358,7 +358,11 @@ class BabyCommunicator(Communicator):
                     if isinstance(result, Exception)
                     else RuntimeError(str(result))
                 )
-                self._errored = self._errored or err
+                # first-error-wins must be atomic: the caller thread resets
+                # _errored at epoch boundaries, so an unlocked `x = x or e`
+                # here could resurrect a cleared error or drop this one
+                with self._lock:
+                    self._errored = self._errored or err
                 self._fail_all(str(err))
                 return
             with self._lock:
@@ -366,7 +370,8 @@ class BabyCommunicator(Communicator):
             if fut is None:
                 continue
             if isinstance(result, Exception):
-                self._errored = self._errored or result
+                with self._lock:  # same first-error-wins atomicity as above
+                    self._errored = self._errored or result
                 fut.set_exception(result)
             else:
                 fut.set_result(result)

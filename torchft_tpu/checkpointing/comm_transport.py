@@ -38,6 +38,7 @@ from torchft_tpu.checkpointing.serialization import (
     heal_chunk_bytes,
     materialize_leaf,
 )
+from torchft_tpu import wire
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.communicator import Communicator
 from torchft_tpu.observability import HealMetrics
@@ -47,8 +48,8 @@ logger = logging.getLogger(__name__)
 T = TypeVar("T")
 
 # tag namespace distinct from collectives (1000s/2000s), broadcast (3000s),
-# alltoall (4000s), allgather (5000s)
-_TAG_BASE = 9000
+# alltoall (4000s), allgather (5000s) — allocated centrally in wire.py
+_TAG_BASE = wire.HEAL_TAG_BASE
 
 # Striped-heal tag offsets inside one step's 10M-wide tag range.  Distinct
 # from the legacy per-array tags (base + 1 + i) so a striped healer paired
@@ -89,7 +90,7 @@ class CommTransport(CheckpointTransport[T]):
         # into the next step's tag range.  Salted by the FULL step (tags are
         # uint64 on both tiers) so a transfer stale by any number of steps
         # can never alias a newer one.
-        return _TAG_BASE * 1000 + step * 10_000_000
+        return _TAG_BASE * 1000 + step * wire.HEAL_STEP_TAG_STRIDE
 
     # submission window: at most this many leaves' host copies are alive at
     # once while streaming a heal (the sends pipeline; the window caps RSS)

@@ -28,6 +28,7 @@ vectorized numpy.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -36,6 +37,7 @@ from typing import Callable, List, Optional, Tuple, Union
 
 import numpy as np
 
+from torchft_tpu import wire
 from torchft_tpu.communicator import Communicator, CommunicatorError
 from torchft_tpu.quantization import (
     DEFAULT_ROW_SIZE,
@@ -46,7 +48,15 @@ from torchft_tpu.quantization import (
     reduce_quantized,
     wire_dtype,
 )
+from torchft_tpu.wire import (
+    DEVICE_QUANT_PIPELINE_TAG_BASE,
+    OUTER_SHARD_TAG_BASE,
+    QUANT_PIPELINE_TAG_BASE,
+    QUANT_RING_TAG,
+)
 from torchft_tpu.work import DummyWork, Work
+
+logger = logging.getLogger(__name__)
 
 Buffers = Union[np.ndarray, List[np.ndarray]]
 
@@ -298,6 +308,30 @@ def _allreduce_pipelined_sync(
         (start, min(start + win, rows)) for start in range(0, rows, win)
     ]
     W = len(windows)
+    # window tags are allocated 2 per window from tag_base; past the span
+    # declared in wire.USER_TAG_ALLOCATIONS they spill into neighboring
+    # allocations (pairing stays unambiguous today only because ops are
+    # serialized per epoch and a2a/ag tags differ in parity — see the
+    # registry comment).  Warn loudly so giant payloads get a bigger
+    # TORCHFT_QUANT_WINDOW_MB instead of relying on that accident.
+    span = next(
+        (
+            s
+            for b, s in wire.USER_TAG_ALLOCATIONS.values()
+            if b == tag_base
+        ),
+        None,
+    )
+    if span is not None and 2 * W > span:
+        logger.warning(
+            "quantized pipeline needs %d windows (%d tags) but tag base %d "
+            "has a span of only %d — raise TORCHFT_QUANT_WINDOW_MB to "
+            "shrink the window count",
+            W,
+            2 * W,
+            tag_base,
+            span,
+        )
     err: Optional[BaseException] = None
     out = np.empty(rows * row_size, dtype=np.float32)
 
@@ -410,7 +444,7 @@ DEFAULT_OUTER_CHUNK_MB = 16.0
 # Pipeline depth cap: tags are allocated 2 per chunk from the sharded-sync
 # tag base, and a deeper pipeline stops paying for itself anyway.
 _MAX_OUTER_CHUNKS = 64
-_OUTER_TAG_BASE = 900
+_OUTER_TAG_BASE = OUTER_SHARD_TAG_BASE
 
 
 def _outer_chunk_ranges(per: int, unit: int, gsize: int) -> List[Tuple[int, int]]:
@@ -796,12 +830,12 @@ def _allreduce_quantized_sync(
     topo = _hier_topology(comm)
     if topo is not None:
         summed = _hier_allreduce_quantized_sync(
-            comm, topo, flat, row_size, kind, tag_base=110
+            comm, topo, flat, row_size, kind, tag_base=QUANT_PIPELINE_TAG_BASE
         )
     else:
         q, scales = quantize_rowwise(flat, row_size, kind)
         summed = _allreduce_pipelined_sync(
-            comm, q, scales, flat.size, tag_base=110
+            comm, q, scales, flat.size, tag_base=QUANT_PIPELINE_TAG_BASE
         )
 
     out: List[np.ndarray] = []
@@ -834,9 +868,12 @@ def allreduce_prequantized(
         # requantize path — leaders alone quantize for the DCN
         flat = dequantize_rowwise(q, scales, n, np.float32)
         return _hier_allreduce_quantized_sync(
-            comm, topo, flat, q.shape[1], _kind_of(q), tag_base=1050
+            comm, topo, flat, q.shape[1], _kind_of(q),
+            tag_base=DEVICE_QUANT_PIPELINE_TAG_BASE,
         )
-    return _allreduce_pipelined_sync(comm, q, scales, n, tag_base=1050)
+    return _allreduce_pipelined_sync(
+        comm, q, scales, n, tag_base=DEVICE_QUANT_PIPELINE_TAG_BASE
+    )
 
 
 def allreduce_quantized(
@@ -914,7 +951,7 @@ def reduce_scatter_quantized(
                 # requantize the full sum and slice this rank's row-shard —
                 # same shard geometry as the flat alltoall path
                 summed = _hier_allreduce_quantized_sync(
-                    comm, topo, flat, row_size, kind, tag_base=103
+                    comm, topo, flat, row_size, kind, tag_base=QUANT_RING_TAG
                 )
                 q_full, s_full = quantize_rowwise(summed, row_size, kind)
                 ws = comm.size()
@@ -930,7 +967,7 @@ def reduce_scatter_quantized(
             else:
                 q_red, s_red, _rows, rows_per_rank = (
                     _quantized_reduce_scatter_sync(
-                        comm, flat, row_size, tag=103, kind=kind
+                        comm, flat, row_size, tag=QUANT_RING_TAG, kind=kind
                     )
                 )
             total = (q_red.astype(np.float32) * s_red[:, None]).reshape(-1)

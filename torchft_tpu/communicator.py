@@ -51,6 +51,7 @@ import numpy as np
 
 from torchft_tpu.futures import TimerHandle, schedule_timeout
 from torchft_tpu.store import create_store_client
+from torchft_tpu import wire as wire_tags
 from torchft_tpu.wire import create_listener
 from torchft_tpu.work import DummyWork, Work
 
@@ -2976,14 +2977,18 @@ class TCPCommunicator(Communicator):
                     # an shm scatter would halve it again but needs
                     # host-contiguous rank chunks, deferred until profiles
                     # demand it.
-                    _hier_allreduce(ctx, flat, op, tag_base=30_000)
+                    _hier_allreduce(
+                        ctx, flat, op, tag_base=wire_tags.RING_REDUCE_TAG_BASE
+                    )
                     bounds = _ring_bounds(flat.size, ws)
                     own = flat[bounds[ctx.rank] : bounds[ctx.rank + 1]]
                 else:
                     # flat, and also the forced one-replica-per-host
                     # topology (leader ring == all ranks): the plain ring
                     # reduce-scatter moves HALF the allreduce's bytes
-                    own = _ring_reduce_scatter(ctx, flat, op, tag_base=30_000)
+                    own = _ring_reduce_scatter(
+                        ctx, flat, op, tag_base=wire_tags.RING_REDUCE_TAG_BASE
+                    )
                 if op == ReduceOp.AVG:
                     if np.issubdtype(own.dtype, np.integer):
                         own //= ws
@@ -3119,7 +3124,7 @@ class TCPCommunicator(Communicator):
             send_for_peer=lambda p: arrays[p],
             recv_template=lambda p: arrays[p],
             own=arrays[rank],
-            tag=4000 + tag,
+            tag=wire_tags.ALLTOALL_TAG_OFFSET + tag,
         )
 
     def allgather(self, data: np.ndarray, tag: int = 0) -> Work:
@@ -3136,13 +3141,15 @@ class TCPCommunicator(Communicator):
                     and ctx.mesh is not None
                     and ctx.mesh.topo is not None
                 ):
-                    return _hier_allgather_sync(ctx, array, 5000 + tag)
+                    return _hier_allgather_sync(
+                        ctx, array, wire_tags.ALLGATHER_TAG_OFFSET + tag
+                    )
                 return _all_exchange_sync(
                     ctx,
                     send_for_peer=lambda p: array,
                     recv_template=lambda p: array,
                     own=array,
-                    tag=5000 + tag,
+                    tag=wire_tags.ALLGATHER_TAG_OFFSET + tag,
                 )
 
             return _run
@@ -3255,7 +3262,7 @@ class _LeaderComm(Communicator):
                     send_for_peer=lambda p: arrays[p],
                     recv_template=lambda p: arrays[p],
                     own=arrays[pos],
-                    tag=7000 + tag,
+                    tag=wire_tags.LEADER_ALLTOALL_TAG_OFFSET + tag,
                     ring=ring,
                 )
 
@@ -3274,7 +3281,7 @@ class _LeaderComm(Communicator):
                     send_for_peer=lambda p: array,
                     recv_template=lambda p: array,
                     own=array,
-                    tag=8000 + tag,
+                    tag=wire_tags.LEADER_ALLGATHER_TAG_OFFSET + tag,
                     ring=ring,
                 )
 
@@ -3341,11 +3348,17 @@ def _allreduce_sync(
         for ring_idx, idxs in enumerate(by_dtype.values()):
             if len(idxs) == 1 and out[idxs[0]].flags.c_contiguous:
                 flat = out[idxs[0]].reshape(-1)
-                reduce_flat(ctx, flat, op, tag_base=ring_idx * 10_000)
+                reduce_flat(
+                    ctx, flat, op,
+                    tag_base=ring_idx * wire_tags.RING_BUFFER_TAG_STRIDE,
+                )
                 out[idxs[0]] = flat.reshape(out[idxs[0]].shape)
                 continue
             flat = np.concatenate([out[i].reshape(-1) for i in idxs])
-            reduce_flat(ctx, flat, op, tag_base=ring_idx * 10_000)
+            reduce_flat(
+                    ctx, flat, op,
+                    tag_base=ring_idx * wire_tags.RING_BUFFER_TAG_STRIDE,
+                )
             offset = 0
             for i in idxs:
                 n = out[i].size
@@ -3526,10 +3539,12 @@ def _hier_allgather_sync(
             other = [g for g in topo.hosts if rank not in g]
             blocks = {g[0]: np.empty(len(g) * n, dtype=np.uint8) for g in other}
             sends = [
-                (g[0], 9000 + tag, _bytes_view(my_block)) for g in other
+                (g[0], wire_tags.HIER_HOST_BLOCK_TAG_OFFSET + tag, _bytes_view(my_block))
+                for g in other
             ]
             recvs = [
-                (g[0], 9000 + tag, _bytes_view(blocks[g[0]])) for g in other
+                (g[0], wire_tags.HIER_HOST_BLOCK_TAG_OFFSET + tag, _bytes_view(blocks[g[0]]))
+                for g in other
             ]
             mesh.exchange(sends, recvs, deadline)
             for g in other:
@@ -3577,12 +3592,17 @@ def _hier_broadcast_sync(
             other_leads = [g[0] for g in topo.hosts if root not in g]
             if other_leads:
                 mesh.exchange(
-                    [(lead, 3000 + i, view) for lead in other_leads],
+                    [
+                        (lead, wire_tags.BROADCAST_TAG_OFFSET + i, view)
+                        for lead in other_leads
+                    ],
                     [],
                     deadline,
                 )
         elif topo.is_leader and not root_local:
-            mesh.exchange([], [(root, 3000 + i, view)], deadline)
+            mesh.exchange(
+                [], [(root, wire_tags.BROADCAST_TAG_OFFSET + i, view)], deadline
+            )
         mesh.shm_bcast(a, deadline, src_idx=src_idx)
     return out
 
@@ -3600,12 +3620,14 @@ def _broadcast_sync(ctx: _CommCtx, arrays: List[np.ndarray], root: int) -> List[
     if ctx.rank == root:
         for i, a in enumerate(out):
             view = _bytes_view(a)
-            sends = [(p, 3000 + i, view) for p in mesh.peers]
+            sends = [
+                (p, wire_tags.BROADCAST_TAG_OFFSET + i, view) for p in mesh.peers
+            ]
             mesh.exchange(sends, [], deadline)
     else:
         for i, a in enumerate(out):
             mesh.exchange(
-                [], [(root, 3000 + i, _bytes_view(a))], deadline
+                [], [(root, wire_tags.BROADCAST_TAG_OFFSET + i, _bytes_view(a))], deadline
             )
     return out
 

@@ -39,6 +39,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from torchft_tpu import knobs
 from torchft_tpu.wire import (
     ROLE_ACTIVE,
     ROLE_SPARE,
@@ -116,11 +117,7 @@ _SPARE_FRESH_FACTOR = 3.0
 
 
 def _spare_promote_enabled() -> bool:
-    return os.environ.get(SPARE_PROMOTE_ENV, "1").lower() not in (
-        "0",
-        "false",
-        "off",
-    )
+    return knobs.get_bool(SPARE_PROMOTE_ENV, True)
 
 
 def _spare_max_lag() -> Optional[int]:

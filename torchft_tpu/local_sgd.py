@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import jax
 import numpy as np
 
+from torchft_tpu import wire
 from torchft_tpu.ddp import allreduce_pytree
 from torchft_tpu.manager import Manager
 
@@ -53,9 +54,10 @@ logger = logging.getLogger(__name__)
 OUTER_SHARD_ENV = "TORCHFT_OUTER_SHARD"
 
 # reshard-exchange collective tags (allgather wire tags 5880/5881 — clear
-# of the sharded pipeline's 900+ chunk tag range and every legacy tag base)
-_RESHARD_LEN_TAG = 880
-_RESHARD_BLOB_TAG = 881
+# of the sharded pipeline's 900+ chunk tag range and every legacy tag base;
+# allocated centrally in wire.USER_TAG_ALLOCATIONS)
+_RESHARD_LEN_TAG = wire.RESHARD_LEN_TAG
+_RESHARD_BLOB_TAG = wire.RESHARD_BLOB_TAG
 
 
 def _outer_shard_mode() -> str:

@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, TypeVar, Union, cast
 
 import numpy as np
 
+from torchft_tpu import knobs
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.observability import QuorumTracer, traced
 from torchft_tpu.checkpointing.transport import CheckpointTransport
@@ -71,12 +72,11 @@ SPARE_WARM_REFRESH_S_ENV = "TORCHFT_SPARE_WARM_REFRESH_S"
 
 
 def _heal_striped_enabled() -> bool:
-    return os.environ.get(HEAL_STRIPED_ENV, "1").lower() not in ("0", "false")
+    return knobs.get_bool(HEAL_STRIPED_ENV, True)
 
 
 def _env_timeout(env: str, default_s: float) -> float:
-    value = os.environ.get(env)
-    return float(value) if value is not None else default_s
+    return knobs.get_float(env, default_s)
 
 
 def extract_trailing_digits(s: str) -> int:
@@ -161,7 +161,7 @@ class Manager:
         self._timeout = _env_timeout(TIMEOUT_SEC_ENV, timeout)
         self._quorum_timeout = _env_timeout(QUORUM_TIMEOUT_SEC_ENV, quorum_timeout)
         self._connect_timeout = _env_timeout(CONNECT_TIMEOUT_SEC_ENV, connect_timeout)
-        quorum_retries = int(os.environ.get(QUORUM_RETRIES_ENV, quorum_retries))
+        quorum_retries = knobs.get_int(QUORUM_RETRIES_ENV, quorum_retries)
         # fail fast on a bad TORCHFT_QUANT_KIND: inside the step it would
         # land in the error funnel and silently discard every step
         from torchft_tpu.quantization import quant_kind
@@ -1292,7 +1292,13 @@ class Manager:
         self._checkpoint_transport.disallow_checkpoint()
 
         if should_commit:
+            # single-writer by protocol: wait_quorum() above joined the
+            # quorum future, so the quorum thread's `_step = max_step` has
+            # a happens-before edge to this train-thread increment, and no
+            # new quorum starts until the train loop calls start_quorum
+            # ftlint: ignore[thread-safety] — ordered by wait_quorum join
             self._step += 1
+            # ftlint: ignore[thread-safety] — ordered by wait_quorum join
             self._batches_committed += self.num_participants()
             self._commit_failures = 0
         else:

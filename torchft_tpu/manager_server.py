@@ -34,10 +34,10 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from torchft_tpu import knobs
 from torchft_tpu.lighthouse import LighthouseClient
 from torchft_tpu.wire import (
     ROLE_ACTIVE,
-    ROLE_SPARE,
     ErrCode,
     ManagerQuorumResult,
     MsgType,
@@ -77,13 +77,8 @@ _WARM_YIELD_S = 0.25
 
 
 def _spare_delta_buf_bytes() -> int:
-    raw = os.environ.get(SPARE_DELTA_BUF_MB_ENV)
-    try:
-        return max(1 << 20, int(float(raw) * (1 << 20))) if raw else 128 << 20
-    except ValueError as e:
-        raise ValueError(
-            f"unparseable {SPARE_DELTA_BUF_MB_ENV}={raw!r} (expected MB)"
-        ) from e
+    mb = knobs.get_float(SPARE_DELTA_BUF_MB_ENV, 128.0)
+    return max(1 << 20, int(mb * (1 << 20)))
 
 
 def compute_quorum_results(
@@ -377,6 +372,10 @@ class ManagerServer:
                     # timeout; interrupt it so it re-registers (idempotent)
                     # against the fresh lighthouse immediately.
                     beat_failures = 0
+                    # single-writer counter: only this heartbeat thread ever
+                    # increments; readers tolerate a stale generation (they
+                    # re-check next round)
+                    # ftlint: ignore[thread-safety] — single-writer counter
                     self._lh_restart_gen += 1
                     self._interrupt_lh_quorum()
             except (OSError, TimeoutError, WireError) as e:

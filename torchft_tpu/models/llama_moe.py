@@ -19,8 +19,6 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import PartitionSpec as P
 
 from torchft_tpu.models.llama import Llama, LlamaConfig
 from torchft_tpu.parallel.moe import MoE, MoEConfig

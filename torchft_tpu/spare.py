@@ -42,6 +42,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from torchft_tpu import knobs
 from torchft_tpu.manager import Manager
 from torchft_tpu.wire import WireError
 
@@ -57,13 +58,7 @@ SPARE_WARM_BUDGET_S_ENV = "TORCHFT_SPARE_WARM_BUDGET_S"  # default 2.0
 
 
 def _env_float(env: str, default: float) -> float:
-    import os
-
-    raw = os.environ.get(env)
-    try:
-        return float(raw) if raw else default
-    except ValueError as e:
-        raise ValueError(f"unparseable {env}={raw!r} (expected float)") from e
+    return knobs.get_float(env, default)
 
 
 class WarmChunkStore:

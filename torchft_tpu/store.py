@@ -18,7 +18,6 @@ can rendezvous on the *primary* replica's store
 from __future__ import annotations
 
 import socket
-import struct
 import threading
 import time
 from typing import Dict, Optional

@@ -146,6 +146,100 @@ class ErrCode(IntEnum):
     SHUTDOWN = 4
 
 
+# ---------------------------------------------------------------------------
+# Data-plane collective tag registry
+# ---------------------------------------------------------------------------
+#
+# Every COMM_DATA frame carries a u64 tag that pairs sends with receives
+# within one mesh epoch.  The tag space used to be allocated by scattered
+# literals (103, 880/881, 900, 4000/5000, 7000/8000, ...); this registry is
+# now the single place tags are assigned, and the ftlint wire checker
+# (torchft_tpu/analysis/wireproto.py) fails the build on any tag literal
+# that is not declared here or any two allocations that collide.
+#
+# Two kinds of entry:
+#
+# - USER allocations: tag values callers pass to alltoall/allgather &c.
+#   Declared as (base, span) — the caller may use [base, base+span).
+# - WIRE offsets: namespace offsets the communicator adds to a user tag so
+#   different primitives' frames can never pair up (alltoall vs allgather
+#   vs leader-ring variants).
+#
+# Ring collectives allocate internally (RING_BUFFER_TAG_STRIDE per buffer,
+# +1000/+2000 phase offsets) and the striped heal salts per step in a
+# 10M-wide range (HEAL_STEP_TAG_STRIDE) on the dedicated p2p lane, so
+# neither can collide with user allocations.
+
+# -- USER tag allocations (value space: what callers pass as `tag=`) --------
+QUANT_RING_TAG = 103  # quantized ring allreduce (collectives.py)
+QUANT_PIPELINE_TAG_BASE = 110  # windowed quant pipeline, 2 tags/window
+QUANT_PIPELINE_TAG_SPAN = 770  # 110..879 (384 windows ≈ 1.5 GB @ 4 MB)
+RESHARD_LEN_TAG = 880  # outer-shard reshard: length exchange (local_sgd.py)
+RESHARD_BLOB_TAG = 881  # outer-shard reshard: blob exchange (local_sgd.py)
+OUTER_SHARD_TAG_BASE = 900  # sharded outer sync, 2 tags/chunk, <=64 chunks
+OUTER_SHARD_TAG_SPAN = 128  # 900..1027
+DEVICE_QUANT_PIPELINE_TAG_BASE = 1050  # on-device dequant+reduce pipeline
+DEVICE_QUANT_PIPELINE_TAG_SPAN = 1950  # 1050..2999 (user tags stay below
+#   every wire offset; the pipeline warns when a payload would need more
+#   windows than its span covers)
+
+# -- WIRE namespace offsets (added by the communicator, never by callers) ---
+BROADCAST_TAG_OFFSET = 3000  # broadcast: offset + buffer index
+ALLTOALL_TAG_OFFSET = 4000  # alltoall frames: offset + user tag
+ALLGATHER_TAG_OFFSET = 5000  # allgather frames: offset + user tag
+LEADER_ALLTOALL_TAG_OFFSET = 7000  # leader-ring alltoall (hierarchical)
+LEADER_ALLGATHER_TAG_OFFSET = 8000  # leader-ring allgather (hierarchical)
+HIER_HOST_BLOCK_TAG_OFFSET = 9000  # hier allgather host-block exchange
+#   (applied ON TOP of ALLGATHER_TAG_OFFSET, so host-block frames live at
+#   14000 + user tag — clear of every first-order namespace)
+
+# -- internal allocators ----------------------------------------------------
+RING_REDUCE_TAG_BASE = 30_000  # explicit reduce_scatter API calls
+RING_BUFFER_TAG_STRIDE = 10_000  # multi-buffer allreduce: buffer i at i*stride
+HEAL_TAG_BASE = 9000  # striped heal (comm_transport.py): base*1000 +
+HEAL_STEP_TAG_STRIDE = 10_000_000  # step*stride salting, p2p lane only
+
+# The machine-readable allocation table the ftlint wire checker enforces:
+# name -> (base, span).  USER allocations must be pairwise disjoint and must
+# stay below the smallest WIRE offset; WIRE offsets must be pairwise
+# >= 1000 apart (the nominal per-namespace width).
+#
+# Honest limit of the static proof: the namespaces are nominal-width, so a
+# user tag above 1000 composed with an offset spills past the next
+# namespace boundary (e.g. allgather(1050+2w) -> 6051+2w crosses 7000 at
+# w >= 475).  Pairing stays unambiguous in practice because within one
+# pipeline the alltoall and allgather window tags have opposite parities
+# and collectives on one communicator epoch are serialized per op thread —
+# but the checker cannot prove that, which is why the quantized pipelines
+# WARN at runtime when a payload would exceed the declared span (see
+# collectives._allreduce_pipelined_sync).
+USER_TAG_ALLOCATIONS = {
+    "QUANT_RING": (QUANT_RING_TAG, 1),
+    "QUANT_PIPELINE": (QUANT_PIPELINE_TAG_BASE, QUANT_PIPELINE_TAG_SPAN),
+    "RESHARD_LEN": (RESHARD_LEN_TAG, 1),
+    "RESHARD_BLOB": (RESHARD_BLOB_TAG, 1),
+    "OUTER_SHARD": (OUTER_SHARD_TAG_BASE, OUTER_SHARD_TAG_SPAN),
+    "DEVICE_QUANT_PIPELINE": (
+        DEVICE_QUANT_PIPELINE_TAG_BASE,
+        DEVICE_QUANT_PIPELINE_TAG_SPAN,
+    ),
+}
+WIRE_TAG_OFFSETS = {
+    "BROADCAST": BROADCAST_TAG_OFFSET,
+    "ALLTOALL": ALLTOALL_TAG_OFFSET,
+    "ALLGATHER": ALLGATHER_TAG_OFFSET,
+    "LEADER_ALLTOALL": LEADER_ALLTOALL_TAG_OFFSET,
+    "LEADER_ALLGATHER": LEADER_ALLGATHER_TAG_OFFSET,
+    "HIER_HOST_BLOCK": HIER_HOST_BLOCK_TAG_OFFSET,
+}
+INTERNAL_TAG_BASES = {
+    "RING_REDUCE": RING_REDUCE_TAG_BASE,
+    "RING_BUFFER_STRIDE": RING_BUFFER_TAG_STRIDE,
+    "HEAL": HEAL_TAG_BASE,
+    "HEAL_STEP_STRIDE": HEAL_STEP_TAG_STRIDE,
+}
+
+
 class WireError(RuntimeError):
     def __init__(self, code: ErrCode, msg: str) -> None:
         super().__init__(msg)
@@ -642,16 +736,9 @@ def connect(addr: str, timeout: float, retries: Optional[int] = None) -> socket.
     host, port_str = addr.rsplit(":", 1)
     host = host.strip("[]")
     if retries is None:
-        try:
-            retries = int(
-                os.environ.get(CONNECT_RETRIES_ENV, "")
-                or _CONNECT_RETRIES_DEFAULT
-            )
-        except ValueError as e:
-            raise ValueError(
-                f"unparseable {CONNECT_RETRIES_ENV}="
-                f"{os.environ.get(CONNECT_RETRIES_ENV)!r} (expected int)"
-            ) from e
+        from torchft_tpu import knobs
+
+        retries = knobs.get_int(CONNECT_RETRIES_ENV, _CONNECT_RETRIES_DEFAULT)
     deadline = time.monotonic() + timeout
     attempt = 0
     while True:
