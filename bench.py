@@ -365,7 +365,7 @@ def worker_main() -> None:
         ev.phase("standby_promoted")
 
     tier = tier_mod.default_tier()
-    comm = tier_mod.make_communicator(timeout_s=30.0, tier=tier)
+    comm = tier_mod.make_communicator(timeout_s=30.0)  # data-plane dispatch
     transport = None
     if os.environ.get("TPUFT_BENCH_HEAL_TRANSPORT", "comm") == "comm":
         # heal over the collective fabric (CommTransport) instead of HTTP:
@@ -1087,7 +1087,7 @@ def _run_single_mode(sizes: Dict[str, int], remat_mode: str) -> Dict[str, Any]:
     manager = None
     try:
         manager = Manager(
-            comm=tier_mod.make_communicator(timeout_s=60.0, tier=tier),
+            comm=tier_mod.make_communicator(timeout_s=60.0),
             load_state_dict=lambda s: holder.update(s),
             state_dict=lambda: dict(holder),
             min_replica_size=1,
@@ -1245,7 +1245,7 @@ def _emit_partial(**updates: Any) -> None:
         print(f"bench: cannot write {_PARTIAL_PATH}: {e}", file=sys.stderr)
 
 
-def _install_hard_deadline(deadline_ts: float) -> None:
+def _install_hard_deadline(deadline_ts: float):
     """Last-resort watchdog for the driver's external ``timeout`` wrapper.
 
     The soft budget checks run BETWEEN phases, so one overrunning phase
@@ -1258,6 +1258,14 @@ def _install_hard_deadline(deadline_ts: float) -> None:
     finished, and exits 0 — a truncated-but-parseable round beats a dead
     one.  ``os._exit`` on purpose: the wedged phase may be blocked in
     uninterruptible jax/socket calls that a SystemExit would never unwind.
+
+    Returns the armed ``threading.Timer`` so the caller can cancel and
+    RE-ARM it tighter once the probe window resolves: the round-5 escape
+    was exactly this gap — the install-time deadline must cover a wedged
+    900 s probe, but on runs where the probe returns in seconds that slack
+    let the in-process legs (the XLA-warmup single phase and the DiLoCo
+    sub-legs, which enforce budgets only BETWEEN fleets) outlive the
+    driver's external timeout before the watchdog ever fired.
     """
     import threading
 
@@ -1292,6 +1300,7 @@ def _install_hard_deadline(deadline_ts: float) -> None:
     timer = threading.Timer(delay, _fire)
     timer.daemon = True
     timer.start()
+    return timer
 
 
 def capture_phase_a_subprocess(
@@ -1429,12 +1438,11 @@ def main() -> None:
     # hard self-deadline: covers the probe window + the phase floor with
     # margin; MUST fire before any external `timeout` wrapper so the round
     # always ends with a parseable artifact + headline instead of rc=124
-    hard_deadline_s = float(
-        os.environ.get("TPUFT_BENCH_HARD_DEADLINE_S", "")
-        or budget_s + 1200.0
-    )
+    hard_deadline_env = os.environ.get("TPUFT_BENCH_HARD_DEADLINE_S", "")
+    hard_deadline_s = float(hard_deadline_env or budget_s + 1200.0)
+    watchdog = None
     if hard_deadline_s > 0:
-        _install_hard_deadline(t_probe_start + hard_deadline_s)
+        watchdog = _install_hard_deadline(t_probe_start + hard_deadline_s)
 
     def remaining_s() -> float:
         return budget_s - (time.time() - t_start)
@@ -1461,6 +1469,23 @@ def main() -> None:
         budget_s - (time.time() - t_probe_start),
     )
     t_start = time.time()
+    if watchdog is not None and not hard_deadline_env:
+        # probe resolved: re-arm the watchdog TIGHT against the remaining
+        # budget (one straddling phase floor + teardown of margin) instead
+        # of the install-time worst case that had to cover a 900 s wedged
+        # probe.  The round-5 rc=124 fired in exactly that slack: probe
+        # done in seconds, legs overran, external timeout < install-time
+        # deadline.  Never re-arm LATER than the install-time deadline (a
+        # slow-but-successful probe would otherwise push past the bound
+        # drivers sized their kill timeouts to); an explicit
+        # TPUFT_BENCH_HARD_DEADLINE_S is honored verbatim.
+        watchdog.cancel()
+        watchdog = _install_hard_deadline(
+            min(
+                t_probe_start + hard_deadline_s,
+                t_start + budget_s + 420.0,
+            )
+        )
     _configure_jax(platform)
 
     import jax
@@ -1749,6 +1774,10 @@ def _run_diloco_phase(
             deadline_s=_budget_left(deadline_ts, 0.25, 90.0),
         )
         print(f"bench: diloco fault-free [{tag}] {r}", file=sys.stderr)
+        # stream EVERY sub-leg into the artifact the moment it lands: the
+        # round-5 loss was per-scenario numbers that existed only on
+        # stderr when the run died between diloco legs
+        _emit_partial(**{f"diloco_faultfree_{tag}": r})
         return r
 
     ff_by_wire: Dict[str, Dict[str, Any]] = {}
@@ -1824,6 +1853,7 @@ def _run_diloco_phase(
             f"{ff_by_wire['replicated']}",
             file=sys.stderr,
         )
+        _emit_partial(diloco_faultfree_replicated=ff_by_wire["replicated"])
     return _diloco_churn_and_summary(
         sizes, worker_platform, replicas, deadline_ts,
         ff_by_wire, faultfree, use_quant, gate, gate_reason,
@@ -1860,6 +1890,7 @@ def _diloco_churn_and_summary(
         deadline_s=_budget_left(deadline_ts, 0.9, 180.0),
     )
     print(f"bench: diloco churn {churn}", file=sys.stderr)
+    _emit_partial(diloco_churn=churn)
     out: Dict[str, Any] = {
         "sync_every": sizes["diloco_sync_every"],
         "fragments": sizes["diloco_fragments"],

@@ -488,6 +488,134 @@ def run_hier_profile(name, gbps, rtt_ms, mb, iters, per_host, hosts=2):
     return {k: (round(v, 4) if isinstance(v, float) else v) for k, v in res.items()}
 
 
+def _tier_rank_main(rank, world, port, mb, iters, gbps, rtt_ms, tier, prefix, out_q):
+    """One rank of the tier A/B row: the SAME in-place f32 allreduce on the
+    selected data plane (cpp = native/libtpuft.so, python = the select-loop
+    _TcpMesh), both shaped by the SAME pacer model (the native tier mirrors
+    _NetEmu behind identical env knobs).  Reports the median step time plus
+    a digest of the reduced bytes so the driver can assert cross-tier
+    bit-identity — the speedup column can never ride a silent divergence."""
+    import hashlib
+
+    os.environ["TORCHFT_NET_GBPS"] = str(gbps)
+    os.environ["TORCHFT_NET_RTT_MS"] = str(rtt_ms)
+    if tier == "cpp":
+        from torchft_tpu.native import CppCommunicator as Comm
+    else:
+        from torchft_tpu.communicator import TCPCommunicator as Comm
+    from torchft_tpu.communicator import ReduceOp
+
+    comm = Comm(timeout_s=300.0)
+    comm.configure(
+        f"127.0.0.1:{port}/{prefix}",
+        replica_id=f"r{rank}",
+        rank=rank,
+        world_size=world,
+    )
+    n = mb * (1 << 20) // 4
+    data = np.random.default_rng(7 + rank).normal(size=n).astype(np.float32)
+    buf = data.copy()
+    out = np.asarray(
+        comm.allreduce(buf, ReduceOp.SUM, in_place=True).wait(timeout=300.0)
+    )
+    digest = hashlib.sha256(out.tobytes()).hexdigest()
+    comm.barrier().wait(timeout=300.0)
+    dts = []
+    for _ in range(max(iters, 5)):
+        np.copyto(buf, data)  # reset outside the timed window
+        t0 = time.perf_counter()
+        comm.allreduce(buf, ReduceOp.SUM, in_place=True).wait(timeout=300.0)
+        dts.append(time.perf_counter() - t0)
+    comm.barrier().wait(timeout=300.0)
+    stats = comm.lane_stats()
+    comm.shutdown()
+    if rank == 0:
+        out_q.put(
+            {
+                "dt": sorted(dts)[len(dts) // 2],
+                "digest": digest,
+                "lanes": stats.get("lanes"),
+                "stalls": sum(stats.get("lane_stalls") or [0]),
+            }
+        )
+
+
+def _run_tier_pair(tiers, port, mb, iters, gbps, rtt_ms, prefix):
+    """Spawn one process per rank (rank r runs tiers[r]) and return rank
+    0's measurement dict."""
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_tier_rank_main,
+            args=(r, len(tiers), port, mb, iters, gbps, rtt_ms, tiers[r],
+                  prefix, out_q),
+        )
+        for r in range(len(tiers))
+    ]
+    for p in procs:
+        p.start()
+    try:
+        res = out_q.get(timeout=1200)
+        for p in procs:
+            p.join(timeout=120)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+    return res
+
+
+def run_tier_profile(name, gbps, rtt_ms, mb, iters):
+    """Native-vs-python data-plane rows for one profile (ISSUE-8 gate):
+    the same 2-rank f32 allreduce on the cpp tier, the python tier, and a
+    MIXED mesh (one rank per tier), all under the same pacer profile.
+
+    The in-bench hard gate is cross-tier bit-identity (all three runs must
+    produce identical bytes); the headline `native_vs_python_speedup` is
+    the acceptance metric — at `dcn_10g` the python select loop's framing,
+    not the emulated link, is the ceiling, so the native tier must clear
+    >= 2x there on a non-starved host."""
+    from torchft_tpu import native
+    from torchft_tpu.store import StoreServer
+
+    if not native.available():
+        return {"native_tier": "unavailable"}
+    store = StoreServer("127.0.0.1:0")
+    try:
+        cpp = _run_tier_pair(
+            ("cpp", "cpp"), store.port, mb, iters, gbps, rtt_ms,
+            f"tier_cpp_{name}",
+        )
+        py = _run_tier_pair(
+            ("python", "python"), store.port, mb, iters, gbps, rtt_ms,
+            f"tier_py_{name}",
+        )
+        mixed = _run_tier_pair(
+            ("python", "cpp"), store.port, mb, iters, gbps, rtt_ms,
+            f"tier_mix_{name}",
+        )
+    finally:
+        store.shutdown()
+    assert cpp["digest"] == py["digest"] == mixed["digest"], (
+        f"cross-tier allreduce diverged at {name}: cpp={cpp['digest'][:12]} "
+        f"py={py['digest'][:12]} mixed={mixed['digest'][:12]}"
+    )
+    payload = mb * (1 << 20)
+    return {
+        "native_allreduce_s": cpp["dt"],
+        "native_allreduce_GBps": round(payload / cpp["dt"] / 1e9, 3),
+        "python_allreduce_s": py["dt"],
+        "python_allreduce_GBps": round(payload / py["dt"] / 1e9, 3),
+        "mixed_allreduce_s": mixed["dt"],
+        "native_vs_python_speedup": round(py["dt"] / cpp["dt"], 3),
+        "native_lanes": cpp["lanes"],
+        "native_stalls": cpp["stalls"],
+        "tier_bit_identical": True,
+    }
+
+
 def run_profile(name, gbps, rtt_ms, mb, iters):
     from torchft_tpu.store import StoreServer
 
@@ -595,11 +723,17 @@ def main():
                     help="skip the hierarchical 2-host topology sweep")
     ap.add_argument("--no-diloco", action="store_true",
                     help="skip the 3-replica sharded-vs-replicated outer-sync sweep")
+    ap.add_argument("--no-tier", action="store_true",
+                    help="skip the native-vs-python data-plane A/B rows")
     args = ap.parse_args()
 
     rows = []
     for name, gbps, rtt in PROFILES:
         row = run_profile(name, gbps, rtt, args.mb, args.iters)
+        if not args.no_tier:
+            # tier A/B at every profile: loopback shows the raw framing
+            # ceilings, dcn_10g carries the >= 2x native acceptance gate
+            row.update(run_tier_profile(name, gbps, rtt, args.mb, args.iters))
         if not args.no_striped:
             row.update(run_striped_profile(name, gbps, rtt, args.mb, args.iters))
         if not args.no_hier and name.startswith("wan_1g"):
@@ -667,6 +801,22 @@ def main():
                 f"| {r['allreduce_4lane_GBps']} GB/s "
                 f"| **{r['allreduce_4lane_speedup']}x** "
                 f"| {flaky} |"
+            )
+        print()
+        print(
+            "| profile | python tier | native tier | native speedup "
+            "| bit-identical |"
+        )
+        print("|---|---|---|---|---|")
+        for r in rows:
+            if "native_vs_python_speedup" not in r:
+                continue
+            print(
+                f"| {r['profile']} "
+                f"| {r['python_allreduce_GBps']} GB/s "
+                f"| {r['native_allreduce_GBps']} GB/s "
+                f"| **{r['native_vs_python_speedup']}x** "
+                f"| {'yes' if r.get('tier_bit_identical') else 'NO'} |"
             )
         print()
         print(

@@ -97,7 +97,9 @@ def main() -> None:
 
     tier = default_tier()  # C++ plane when native/libtpuft.so loads
     manager = Manager(
-        comm=make_communicator(timeout_s=args.comm_timeout, tier=tier),
+        # comm tier resolves separately (data_plane_tier): auto downgrades
+        # to python under forced-hierarchical topologies, with a loud log
+        comm=make_communicator(timeout_s=args.comm_timeout),
         load_state_dict=lambda s: holder.update(s),
         state_dict=lambda: dict(holder),
         min_replica_size=args.min_replicas,

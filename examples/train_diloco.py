@@ -93,7 +93,7 @@ def main() -> None:
 
     tier = default_tier()  # C++ plane when native/libtpuft.so loads
     manager = Manager(
-        comm=make_communicator(timeout_s=60.0, tier=tier),
+        comm=make_communicator(timeout_s=60.0),  # data-plane tier dispatch
         load_state_dict=lambda s: holder.update(s),
         state_dict=lambda: dict(holder),
         min_replica_size=args.min_replicas,

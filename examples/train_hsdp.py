@@ -69,7 +69,7 @@ def main() -> None:
 
     tier = default_tier()  # C++ plane when native/libtpuft.so loads
     manager = Manager(
-        comm=make_communicator(timeout_s=60.0, tier=tier),
+        comm=make_communicator(timeout_s=60.0),  # data-plane tier dispatch
         load_state_dict=None,  # HSDPTrainer registers its own entry
         state_dict=None,
         min_replica_size=args.min_replicas,

@@ -134,6 +134,19 @@ int tpuft_comm_allreduce(void* h, void* data, uint64_t nbytes, int32_t dtype,
   });
 }
 
+// zero-copy multi-buffer allreduce: `bufs`/`lens` describe n scattered
+// caller buffers (all holding whole elements of `dtype`) treated as one
+// logical payload — frames leave and land via sendmsg/recvmsg straight
+// against these buffers, no staging concatenation on either side.
+int tpuft_comm_allreduce_iov(void* h, void* const* bufs, const uint64_t* lens,
+                             uint64_t n, int32_t dtype, int32_t op) {
+  auto* comm = static_cast<tpuft::Communicator*>(h);
+  return guarded([&] {
+    comm->allreduce_iov(bufs, lens, n, static_cast<tpuft::DType>(dtype),
+                        static_cast<tpuft::RedOp>(op));
+  });
+}
+
 int tpuft_comm_reduce_scatter(void* h, void* data, uint64_t nbytes,
                               int32_t dtype, int32_t op, void* out,
                               uint64_t out_cap, uint64_t* out_bytes) {
@@ -182,10 +195,29 @@ int tpuft_comm_alltoall(void* h, const void* in, void* out,
   return guarded([&] { comm->alltoall(in, out, chunk_bytes, tag); });
 }
 
+// scatter-gather alltoall: one pointer per destination rank's chunk (the
+// chunks need not be contiguous with each other)
+int tpuft_comm_alltoall_ptrs(void* h, const void* const* ins, void* out,
+                             uint64_t chunk_bytes, uint64_t tag) {
+  auto* comm = static_cast<tpuft::Communicator*>(h);
+  return guarded([&] { comm->alltoall_ptrs(ins, out, chunk_bytes, tag); });
+}
+
 int tpuft_comm_allgather(void* h, const void* in, void* out,
                          uint64_t chunk_bytes, uint64_t tag) {
   auto* comm = static_cast<tpuft::Communicator*>(h);
   return guarded([&] { comm->allgather(in, out, chunk_bytes, tag); });
+}
+
+// per-lane counters of the current epoch (tx/rx payload bytes, stall
+// events) — the native half of the tier-agnostic lane_stats() surface.
+// Returns the lane count; fills up to `cap` entries per array.
+uint64_t tpuft_comm_lane_stats(void* h, uint64_t* tx, uint64_t* rx,
+                               uint64_t* stalls, uint64_t cap,
+                               uint64_t* stripe_floor) {
+  auto* comm = static_cast<tpuft::Communicator*>(h);
+  *stripe_floor = comm->stripe_floor();
+  return comm->lane_stats(tx, rx, stalls, cap);
 }
 
 int tpuft_comm_barrier(void* h) {

@@ -1,8 +1,12 @@
 // Host-side data-plane communicator — C++ twin of the Python
 // TCPCommunicator mesh tier (torchft_tpu/communicator.py), built for DCN
-// throughput: poll()-driven duplex IO on non-blocking sockets, large socket
-// buffers, -O3 vectorized reduction loops, ring allreduce
-// (reduce-scatter + allgather), alltoall/allgather, broadcast, send/recv.
+// throughput: blocking duplex IO on persistent per-lane worker threads,
+// scatter-gather sendmsg/recvmsg framing (multi-buffer payloads are never
+// assembled in a staging copy), -O3 vectorized reduction loops, ring
+// allreduce (reduce-scatter + allgather), alltoall/allgather, broadcast,
+// send/recv, and a token-bucket network emulator mirroring the Python
+// tier's _NetEmu (same env knobs, same profiles) so cross-tier benches
+// shape both planes identically.
 //
 // All ops are synchronous at this level and abortable: abort() flips a flag
 // and shuts the sockets down, unblocking any op mid-IO (the userspace
@@ -13,13 +17,20 @@
 #pragma once
 
 #include <fcntl.h>
+#include <sys/socket.h>
 #include <sys/uio.h>
 
 #include <algorithm>
 #include <atomic>
+#include <cctype>
 #include <chrono>
+#include <condition_variable>
 #include <cstring>
+#include <deque>
+#include <functional>
 #include <map>
+#include <memory>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -134,25 +145,519 @@ struct CommError : std::runtime_error {
   using std::runtime_error::runtime_error;
 };
 
+// --- network emulation (mirror of communicator._NetEmu) ---------------------
+//
+// Deterministic sender-side pacing behind the SAME env knobs as the Python
+// tier — TORCHFT_NET_EMU (named profile), TORCHFT_NET_GBPS /
+// TORCHFT_NET_RTT_MS (raw overrides), TORCHFT_NET_CWND_KB (per-stream
+// congestion-window cap) — so a cross-tier bench shapes both planes with
+// one model: a process-shared link token bucket (one process = one
+// emulated host NIC), a per-connection cwnd-limited stream bucket, and a
+// half-RTT gate before each frame's first byte.  Profile names and values
+// must match communicator._NET_EMU_PROFILES exactly (ftlint native-mirror
+// checks them).
+
+struct NetProfile {
+  const char* name;
+  double gbps;
+  double rtt_ms;
+};
+
+// (name, link Gbit/s, RTT ms) — mirror of communicator._NET_EMU_PROFILES
+constexpr NetProfile kNetEmuProfiles[] = {
+    {"wan_1g", 1.0, 10.0},     {"wan_1g_10ms", 1.0, 10.0},
+    {"dcn_10g", 10.0, 2.0},    {"dcn_10g_2ms", 10.0, 2.0},
+    {"loopback", 0.0, 0.0},
+};
+
+class Pacer {
+ public:
+  // capped-accrual token bucket, the _StreamBucket math verbatim
+  struct Bucket {
+    double rate = 0.0;
+    double burst = 0.0;
+    double tokens = 0.0;
+    std::chrono::steady_clock::time_point last;
+
+    Bucket() = default;
+    Bucket(double r, double b)
+        : rate(r), burst(b), tokens(b), last(std::chrono::steady_clock::now()) {}
+
+    size_t allow(size_t want) {
+      auto now = std::chrono::steady_clock::now();
+      tokens = std::min(
+          burst, tokens + std::chrono::duration<double>(now - last).count() * rate);
+      last = now;
+      double cap = tokens < 0 ? 0.0 : tokens;
+      return static_cast<size_t>(
+          std::min<double>(static_cast<double>(want), cap));
+    }
+    void consume(size_t n) { tokens -= static_cast<double>(n); }
+  };
+
+  Pacer(double gbps, double rtt_ms, size_t cwnd_bytes)
+      : bytes_per_s_(gbps * 1e9 / 8.0),
+        rtt_s_(rtt_ms / 1e3),
+        half_rtt_s_(rtt_ms / 2e3),
+        cwnd_bytes_(cwnd_bytes) {
+    stream_bytes_per_s_ = (cwnd_bytes_ > 0 && rtt_s_ > 0)
+                              ? static_cast<double>(cwnd_bytes_) / rtt_s_
+                              : 0.0;
+    if (bytes_per_s_ > 0) {
+      double burst = std::max<double>(64 << 10, bytes_per_s_ * 0.005);
+      link_ = shared_link(bytes_per_s_, burst);
+    }
+  }
+
+  // parse TORCHFT_NET_EMU / TORCHFT_NET_GBPS / TORCHFT_NET_RTT_MS /
+  // TORCHFT_NET_CWND_KB; nullptr when unshaped.  An unknown profile is
+  // LOUD (like the Python tier): a typo'd profile must not record
+  // loopback numbers as a DCN run.
+  static std::unique_ptr<Pacer> from_env() {
+    const char* raw = std::getenv("TORCHFT_NET_EMU");
+    std::string profile = raw ? raw : "";
+    // strip + lowercase exactly like the Python _net_emu_from_env: a
+    // trailing space from a YAML export must not fail only one tier
+    while (!profile.empty() && std::isspace(profile.front()))
+      profile.erase(profile.begin());
+    while (!profile.empty() && std::isspace(profile.back()))
+      profile.pop_back();
+    std::transform(profile.begin(), profile.end(), profile.begin(), ::tolower);
+    double prof_gbps = 0.0, prof_rtt = 0.0;
+    if (!profile.empty()) {
+      bool found = false;
+      for (const auto& p : kNetEmuProfiles) {
+        if (profile == p.name) {
+          prof_gbps = p.gbps;
+          prof_rtt = p.rtt_ms;
+          found = true;
+          break;
+        }
+      }
+      if (!found)
+        throw CommError("unknown TORCHFT_NET_EMU profile '" + profile + "'");
+    }
+    double gbps = env_double("TORCHFT_NET_GBPS", prof_gbps);
+    double rtt_ms = env_double("TORCHFT_NET_RTT_MS", prof_rtt);
+    size_t cwnd =
+        static_cast<size_t>(env_double("TORCHFT_NET_CWND_KB", 256.0) * 1024);
+    if (gbps <= 0 && rtt_ms <= 0) return nullptr;
+    return std::make_unique<Pacer>(gbps, rtt_ms, cwnd);
+  }
+
+  double half_rtt_s() const { return half_rtt_s_; }
+  double rtt_s() const { return rtt_s_; }
+  double bytes_per_s() const { return bytes_per_s_; }
+  double stream_bytes_per_s() const { return stream_bytes_per_s_; }
+
+  // the largest grant allow() can ever return (the tightest engaged
+  // bucket's burst) — callers batching paced sends must not wait for more
+  size_t max_grant() const {
+    double cap = 1e18;
+    if (link_)
+      cap = std::min(cap, std::max<double>(64 << 10, bytes_per_s_ * 0.005));
+    if (stream_bytes_per_s_ > 0)
+      cap = std::min(cap, static_cast<double>(cwnd_bytes_));
+    return static_cast<size_t>(cap);
+  }
+
+  // RTT x bandwidth product — the natural frame size on this profile
+  size_t bdp_bytes() const {
+    if (bytes_per_s_ <= 0 || rtt_s_ <= 0) return 0;
+    return static_cast<size_t>(bytes_per_s_ * rtt_s_);
+  }
+
+  // bytes the link (and, when RTT emulation is on, `stream`'s cwnd bucket)
+  // permit right now (<= want); stream is the connection identity (its fd)
+  size_t allow(size_t want, uint64_t stream) {
+    if (link_) {
+      std::lock_guard<std::mutex> lock(link_->mu);
+      want = link_->bucket.allow(want);
+    }
+    if (stream_bytes_per_s_ > 0 && want > 0) {
+      std::lock_guard<std::mutex> lock(mu_);
+      auto it = streams_.find(stream);
+      if (it == streams_.end())
+        it = streams_
+                 .emplace(stream, Bucket(stream_bytes_per_s_,
+                                         static_cast<double>(cwnd_bytes_)))
+                 .first;
+      want = it->second.allow(want);
+    }
+    return want;
+  }
+
+  void consume(size_t n, uint64_t stream) {
+    if (link_) {
+      std::lock_guard<std::mutex> lock(link_->mu);
+      link_->bucket.consume(n);
+    }
+    if (stream_bytes_per_s_ > 0) {
+      std::lock_guard<std::mutex> lock(mu_);
+      auto it = streams_.find(stream);
+      if (it != streams_.end()) it->second.consume(n);
+    }
+  }
+
+ private:
+  struct Link {
+    std::mutex mu;
+    Bucket bucket;
+  };
+
+  // the LINK bucket is process-shared (one process = one emulated host
+  // NIC, communicator._LinkBucket): every communicator in the process
+  // draws from the same bucket keyed by the link parameters
+  static Link* shared_link(double rate, double burst) {
+    static std::mutex registry_mu;
+    static std::map<std::pair<double, double>, std::unique_ptr<Link>> registry;
+    std::lock_guard<std::mutex> lock(registry_mu);
+    auto key = std::make_pair(rate, burst);
+    auto it = registry.find(key);
+    if (it == registry.end()) {
+      auto link = std::make_unique<Link>();
+      link->bucket = Bucket(rate, burst);
+      it = registry.emplace(key, std::move(link)).first;
+    }
+    return it->second.get();
+  }
+
+  static double env_double(const char* name, double fallback) {
+    const char* v = std::getenv(name);
+    if (!v || !*v) return fallback;
+    char* end = nullptr;
+    double out = std::strtod(v, &end);
+    if (end == v)
+      throw CommError(std::string("unparseable ") + name + "=" + v);
+    return out;
+  }
+
+  double bytes_per_s_;
+  double rtt_s_;
+  double half_rtt_s_;
+  size_t cwnd_bytes_;
+  double stream_bytes_per_s_ = 0.0;
+  Link* link_ = nullptr;
+  std::mutex mu_;
+  std::map<uint64_t, Bucket> streams_;
+};
+
 // Parallel-connection ("lane") config for striped collectives — must agree
 // with the Python tier (torchft_tpu/communicator.py _ring_lanes /
 // _stripe_floor) and be uniform across ranks (verified in the rendezvous
-// hello).  The native tier has no network emulator, so "auto" resolves to 1
-// here; set an explicit integer in mixed-tier deployments.
-inline size_t ring_lanes_from_env() {
+// hello).  "auto" resolves exactly like the Python tier: enough lanes that
+// the aggregate cwnd-limited stream rate reaches the emulated link rate
+// (capped at kMaxAutoLanes), 1 when unshaped.
+constexpr size_t kMaxAutoLanes = 4;  // mirror of communicator._MAX_AUTO_LANES
+constexpr size_t kMinStripeBytes =
+    size_t(64) << 10;  // mirror of communicator._MIN_STRIPE_BYTES
+
+inline size_t ring_lanes_from_env(const Pacer* pacer) {
   const char* v = std::getenv("TORCHFT_RING_LANES");
-  if (!v || !*v || std::string(v) == "auto") return 1;
-  long n = std::strtol(v, nullptr, 10);
-  return n >= 1 ? static_cast<size_t>(n) : 1;
+  if (v && *v && std::string(v) != "auto") {
+    long n = std::strtol(v, nullptr, 10);
+    return n >= 1 ? static_cast<size_t>(n) : 1;
+  }
+  if (!pacer || pacer->stream_bytes_per_s() <= 0 || pacer->bytes_per_s() <= 0)
+    return 1;
+  size_t link = static_cast<size_t>(pacer->bytes_per_s());
+  size_t stream =
+      std::max<size_t>(1, static_cast<size_t>(pacer->stream_bytes_per_s()));
+  size_t need = (link + stream - 1) / stream;
+  return std::max<size_t>(1, std::min(kMaxAutoLanes, need));
 }
 
-inline size_t stripe_floor_from_env() {
+inline size_t stripe_floor_from_env(const Pacer* pacer) {
   const char* v = std::getenv("TORCHFT_RING_FRAME_KB");
-  if (!v || !*v || std::string(v) == "auto") return size_t(64) << 10;
-  double kb = std::strtod(v, nullptr);
-  size_t b = static_cast<size_t>(kb * 1024);
-  return b < 64 ? 64 : b;
+  if (v && *v && std::string(v) != "auto") {
+    double kb = std::strtod(v, nullptr);
+    size_t b = static_cast<size_t>(kb * 1024);
+    return b < 64 ? 64 : b;
+  }
+  if (pacer) {
+    size_t bdp = pacer->bdp_bytes();
+    if (bdp > 0)
+      // jumbo frames on DCN: one sub-frame covers at least a BDP so the
+      // half-RTT frame gate amortizes (mirror of communicator._stripe_floor)
+      return std::max(kMinStripeBytes, std::min(bdp, size_t(8) << 20));
+  }
+  return kMinStripeBytes;
 }
+
+// --- scatter-gather framing --------------------------------------------------
+//
+// One logical frame may be backed by MANY caller buffers (a gradient
+// bucket's arrays, quantized rows + scales, chunked outer shards).  The
+// iovec plumbing below sends and receives such frames with sendmsg /
+// recvmsg straight against the callers' memory — the payload is never
+// assembled in a staging copy on either side.
+
+// max payload iovec segments per sendmsg/recvmsg call (the header rides as
+// one more); bounded well under IOV_MAX.  Mirrored in native.py
+// (_MAX_IOV_SEGS) so the binding's segment batching agrees.
+constexpr size_t kMaxIovSegs = 64;
+
+// paced sends coalesce token dribbles: below this floor (clamped to half
+// the pacer's max grant) the sender naps briefly instead of issuing a
+// sendmsg per few-KB accrual — the nap is short enough that the bucket
+// (whose burst is at least twice the floor) never tops out and wastes
+// tokens even when a loaded host oversleeps
+constexpr size_t kPaceMinSendBytes = 32 << 10;
+
+// Walks a logical byte range expressed as iovec segments; fill() emits a
+// bounded iovec batch for one sendmsg/recvmsg, advance() consumes it.
+class IovCursor {
+ public:
+  IovCursor() = default;
+  explicit IovCursor(std::vector<struct iovec> iov) : iov_(std::move(iov)) {
+    for (const auto& v : iov_) remaining_ += v.iov_len;
+  }
+
+  size_t remaining() const { return remaining_; }
+
+  // fill up to max_segs entries covering at most max_bytes, starting at
+  // the cursor; returns the entry count (0 when exhausted or clamped)
+  int fill(struct iovec* out, size_t max_segs, size_t max_bytes) const {
+    size_t idx = idx_, off = off_, budget = max_bytes;
+    size_t cnt = 0;
+    while (idx < iov_.size() && cnt < max_segs && budget > 0) {
+      uint8_t* base = static_cast<uint8_t*>(iov_[idx].iov_base) + off;
+      size_t len = std::min(iov_[idx].iov_len - off, budget);
+      if (len == 0) break;
+      out[cnt].iov_base = base;
+      out[cnt].iov_len = len;
+      ++cnt;
+      budget -= len;
+      ++idx;
+      off = 0;
+    }
+    return static_cast<int>(cnt);
+  }
+
+  void advance(size_t n) {
+    remaining_ -= n;
+    while (n > 0) {
+      size_t left = iov_[idx_].iov_len - off_;
+      if (n < left) {
+        off_ += n;
+        return;
+      }
+      n -= left;
+      ++idx_;
+      off_ = 0;
+    }
+  }
+
+ private:
+  std::vector<struct iovec> iov_;
+  size_t idx_ = 0;
+  size_t off_ = 0;
+  size_t remaining_ = 0;
+};
+
+// A logical contiguous byte space backed by scattered segments (one per
+// caller buffer).  Ring chunk math runs over LOGICAL offsets; the IO layer
+// resolves them to segment slices at the syscall boundary.  Segment
+// boundaries fall between whole arrays of one dtype, so an element never
+// straddles segments and per-segment reduction is exact.
+class ScatterView {
+ public:
+  ScatterView(void* data, size_t nbytes) : total_(nbytes) {
+    segs_.emplace_back(static_cast<uint8_t*>(data), nbytes);
+    starts_.push_back(0);
+  }
+
+  ScatterView(void* const* bufs, const uint64_t* lens, size_t n) {
+    size_t off = 0;
+    for (size_t i = 0; i < n; ++i) {
+      if (lens[i] == 0) continue;
+      segs_.emplace_back(static_cast<uint8_t*>(bufs[i]),
+                         static_cast<size_t>(lens[i]));
+      starts_.push_back(off);
+      off += lens[i];
+    }
+    total_ = off;
+  }
+
+  size_t size() const { return total_; }
+
+  // iovec list covering logical [off, off+len)
+  std::vector<struct iovec> slice(size_t off, size_t len) const {
+    std::vector<struct iovec> out;
+    if (len == 0) return out;
+    size_t i = seg_at(off);
+    while (len > 0) {
+      size_t seg_off = off - starts_[i];
+      size_t take = std::min(segs_[i].second - seg_off, len);
+      out.push_back({segs_[i].first + seg_off, take});
+      off += take;
+      len -= take;
+      ++i;
+    }
+    return out;
+  }
+
+  // pointer when [off, off+len) lies inside ONE segment, else nullptr
+  uint8_t* contiguous(size_t off, size_t len) const {
+    size_t i = seg_at(off);
+    size_t seg_off = off - starts_[i];
+    if (segs_[i].second - seg_off >= len) return segs_[i].first + seg_off;
+    return nullptr;
+  }
+
+  // acc[off : off+len] ?= src, segment crossings handled (boundaries are
+  // element-aligned by construction)
+  void reduce_in(size_t off, const void* src, size_t len, DType dt, RedOp op) {
+    const uint8_t* s = static_cast<const uint8_t*>(src);
+    size_t i = seg_at(off);
+    while (len > 0) {
+      size_t seg_off = off - starts_[i];
+      size_t take = std::min(segs_[i].second - seg_off, len);
+      reduce_buffer(segs_[i].first + seg_off, s, take, dt, op);
+      s += take;
+      off += take;
+      len -= take;
+      ++i;
+    }
+  }
+
+ private:
+  size_t seg_at(size_t off) const {
+    // binary search the covering segment
+    size_t lo = 0, hi = starts_.size();
+    while (lo + 1 < hi) {
+      size_t mid = (lo + hi) / 2;
+      if (starts_[mid] <= off)
+        lo = mid;
+      else
+        hi = mid;
+    }
+    return lo;
+  }
+
+  std::vector<std::pair<uint8_t*, size_t>> segs_;
+  std::vector<size_t> starts_;
+  size_t total_ = 0;
+};
+
+// --- per-lane worker threads -------------------------------------------------
+//
+// One persistent tx and one persistent rx worker per (peer, lane) link,
+// replacing the short-lived thread spawns of the round-1 build (a thread
+// create + join per frame part per ring step).  Workers are created
+// lazily at first use, live for the epoch, and drain with errors after
+// abort() (sockets are shut down, so blocked IO returns immediately).
+
+class LanePool {
+ public:
+  static constexpr int kTx = 0;
+  static constexpr int kRx = 1;
+
+  ~LanePool() { shutdown(); }
+
+  void submit(int64_t peer, size_t lane, int dir, std::function<void()> fn) {
+    Worker* w = nullptr;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (!stopped_) {
+        uint64_t key = (static_cast<uint64_t>(peer) << 16) |
+                       (static_cast<uint64_t>(lane & 0x7FFF) << 1) |
+                       static_cast<uint64_t>(dir & 1);
+        auto it = workers_.find(key);
+        if (it == workers_.end()) {
+          it = workers_.emplace(key, std::make_unique<Worker>()).first;
+          Worker* raw = it->second.get();
+          raw->th = std::thread([raw] { raw->run(); });
+        }
+        w = it->second.get();
+      }
+    }
+    if (w == nullptr) {
+      // pool already stopped (epoch superseded): run inline — the task
+      // fails fast against the shut-down sockets, releasing its latch
+      fn();
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lock(w->mu);
+      w->q.push_back(std::move(fn));
+    }
+    w->cv.notify_one();
+  }
+
+  void shutdown() {
+    std::map<uint64_t, std::unique_ptr<Worker>> workers;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (stopped_) return;
+      stopped_ = true;
+      workers.swap(workers_);
+    }
+    for (auto& [key, w] : workers) {
+      {
+        std::lock_guard<std::mutex> lock(w->mu);
+        w->stop = true;
+      }
+      w->cv.notify_all();
+      if (w->th.joinable()) w->th.join();
+    }
+  }
+
+ private:
+  struct Worker {
+    std::thread th;
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<std::function<void()>> q;
+    bool stop = false;
+
+    void run() {
+      while (true) {
+        std::function<void()> fn;
+        {
+          std::unique_lock<std::mutex> lock(mu);
+          cv.wait(lock, [&] { return stop || !q.empty(); });
+          if (q.empty()) return;  // stop requested and drained
+          fn = std::move(q.front());
+          q.pop_front();
+        }
+        fn();
+      }
+    }
+  };
+
+  std::mutex mu_;
+  bool stopped_ = false;
+  std::map<uint64_t, std::unique_ptr<Worker>> workers_;
+};
+
+// completion latch for a fan-out of lane tasks; collects the first error
+struct OpLatch {
+  std::mutex mu;
+  std::condition_variable cv;
+  size_t pending = 0;
+  std::string err;
+
+  void add(size_t n) {
+    std::lock_guard<std::mutex> lock(mu);
+    pending += n;
+  }
+  void done(const std::string& e) {
+    std::lock_guard<std::mutex> lock(mu);
+    if (!e.empty() && err.empty()) err = e;
+    if (--pending == 0) cv.notify_all();
+  }
+  // wait without throwing; returns the first error ("" when clean)
+  std::string wait_quiet() {
+    std::unique_lock<std::mutex> lock(mu);
+    cv.wait(lock, [&] { return pending == 0; });
+    return err;
+  }
+  void wait() {
+    std::string e = wait_quiet();
+    if (!e.empty()) throw CommError(e);
+  }
+};
 
 // --- hierarchical topology (leader ring) ------------------------------------
 //
@@ -167,6 +672,8 @@ inline size_t stripe_floor_from_env() {
 // a group makes the Python ranks' "auto" fall back to the flat ring (and a
 // forced TORCHFT_HIERARCHICAL=1 fail loudly); these helpers pin the math a
 // full native topology integration must reproduce byte-for-byte.
+// (tier.py data_plane_tier() downgrades auto-mode native selection to the
+// Python tier whenever hierarchical dispatch is forced on, logging it.)
 
 // TORCHFT_HIERARCHICAL: "auto" (default) | "0" | "1" — must be uniform
 // across replicas, like TORCHFT_RING_LANES.
@@ -219,12 +726,86 @@ struct HostTopology {
 // tier's _LANE_HELLO_FLAG.
 constexpr uint64_t kLaneHelloFlag = uint64_t(1) << 63;
 
+// Explicit reduce_scatter API calls ride their own tag window, clear of
+// the allreduce rings — mirror of wire.RING_REDUCE_TAG_BASE (the round-1
+// build framed them at tag base 0, colliding with a Python peer's 30000
+// window; mixed-tier meshes now pin this).
+constexpr uint64_t kRingReduceTagBase = 30000;
+
+// Per-epoch IO state: the pacer, the per-lane counters, and the lane
+// config they index.  Ops snapshot ONE shared_ptr at entry — configure()
+// swaps in a fresh instance while a superseded op thread may still be
+// mid-IO on the old epoch's state, and the shared_ptr keeps that state
+// alive exactly as long as any late op references it (the same doctrine
+// as the fd graveyard, without unbounded growth or torn pointer reads).
+struct EpochIO {
+  std::unique_ptr<Pacer> pacer;
+  size_t lanes = 1;
+  size_t stripe_floor = kMinStripeBytes;
+  // per-lane observability: payload bytes moved and stall events (pacer
+  // denials / kernel would-block), names mirroring _TcpMesh lane_tx_bytes
+  // / lane_rx_bytes / lane_stalls
+  std::unique_ptr<std::atomic<uint64_t>[]> tx, rx, stalls;
+
+  void alloc_counters() {
+    tx.reset(new std::atomic<uint64_t>[lanes]());
+    rx.reset(new std::atomic<uint64_t>[lanes]());
+    stalls.reset(new std::atomic<uint64_t>[lanes]());
+  }
+  void stall(size_t lane) {
+    if (stalls && lane < lanes)
+      stalls[lane].fetch_add(1, std::memory_order_relaxed);
+  }
+  void add_tx(size_t lane, size_t n) {
+    if (tx && lane < lanes) tx[lane].fetch_add(n, std::memory_order_relaxed);
+  }
+  void add_rx(size_t lane, size_t n) {
+    if (rx && lane < lanes) rx[lane].fetch_add(n, std::memory_order_relaxed);
+  }
+
+  // half-RTT gate before a frame's first byte (mirror of the Python
+  // exchange loop's frame_gates) — the pacer's RTT model, not a stall
+  void gate() const {
+    if (!pacer || pacer->half_rtt_s() <= 0) return;
+    std::this_thread::sleep_for(
+        std::chrono::duration<double>(pacer->half_rtt_s()));
+  }
+
+  // deterministic per-lane split of one frame; identical math to the
+  // Python tier (_lane_parts) — see Communicator::lane_parts
+  std::vector<std::pair<size_t, size_t>> lane_parts(size_t nbytes) const {
+    if (lanes <= 1 || nbytes < 2 * stripe_floor) return {{0, nbytes}};
+    size_t k = std::min(lanes, std::max<size_t>(1, nbytes / stripe_floor));
+    if (k <= 1) return {{0, nbytes}};
+    std::vector<size_t> bounds{0};
+    for (size_t i = 1; i < k; ++i) {
+      size_t cut = (i * nbytes / k) / 64 * 64;
+      bounds.push_back(std::max(cut, bounds.back()));
+    }
+    bounds.push_back(nbytes);
+    std::vector<std::pair<size_t, size_t>> parts;
+    for (size_t i = 0; i < k; ++i) parts.emplace_back(bounds[i], bounds[i + 1]);
+    return parts;
+  }
+};
+
+using IoPtr = std::shared_ptr<EpochIO>;
+
 class Communicator {
  public:
-  explicit Communicator(double timeout_s) : timeout_s_(timeout_s) {}
+  explicit Communicator(double timeout_s)
+      : timeout_s_(timeout_s), io_(std::make_shared<EpochIO>()) {}
 
   ~Communicator() {
     abort();
+    {
+      std::shared_ptr<LanePool> pool;
+      {
+        std::lock_guard<std::mutex> lock(state_mu_);
+        pool = std::move(pool_);
+      }
+      if (pool) pool->shutdown();
+    }
     close_peers();
   }
 
@@ -232,12 +813,14 @@ class Communicator {
   // for each pair (i, j) with i < j, j dials i — once per LANE.  Lanes are
   // parallel TCP connections one logical collective stripes frames across
   // (lane_parts); the Python tier (_TcpMesh) speaks the identical protocol:
-  // legacy 8-byte hello (rank) at 1 lane, 24-byte hello (rank, lane, lane
-  // count) otherwise, lane count verified loudly.  store_prefixed_addr is
-  // "host:port/prefix/..." exactly like the Python tier.
+  // legacy 8-byte hello (rank) at 1 lane, 32-byte `(rank|flag, lane, lane
+  // count, stripe floor)` hello otherwise, lane count verified loudly.
+  // store_prefixed_addr is "host:port/prefix/..." exactly like the Python
+  // tier.
   void configure(const std::string& store_prefixed_addr, int64_t rank,
                  int64_t world_size) {
     abort();  // supersede any previous epoch
+    std::shared_ptr<LanePool> old_pool;
     {
       // old fds go to the graveyard (closed at destruction): an op thread
       // may still reference them, and closing now could recycle fd numbers
@@ -245,12 +828,28 @@ class Communicator {
       for (auto& [peer, fds] : peers_)
         for (int fd : fds) graveyard_.push_back(fd);
       peers_.clear();
+      old_pool = std::move(pool_);
     }
+    // join the superseded epoch's lane workers: their sockets are shut
+    // down, so any in-flight task errors out within one IO quantum
+    if (old_pool) old_pool->shutdown();
     aborted_ = false;
+    // fresh per-epoch IO state; a superseded op thread keeps the OLD
+    // instance alive through its own shared_ptr snapshot
+    auto io = std::make_shared<EpochIO>();
+    io->pacer = Pacer::from_env();
+    io->lanes = ring_lanes_from_env(io->pacer.get());
+    io->stripe_floor = stripe_floor_from_env(io->pacer.get());
+    io->alloc_counters();
+    lanes_ = io->lanes;
+    stripe_floor_ = io->stripe_floor;
     rank_ = rank;
     world_size_ = world_size;
-    lanes_ = ring_lanes_from_env();
-    stripe_floor_ = stripe_floor_from_env();
+    {
+      std::lock_guard<std::mutex> lock(state_mu_);
+      io_ = std::move(io);
+      pool_ = std::make_shared<LanePool>();
+    }
     if (world_size <= 1) return;
 
     auto slash = store_prefixed_addr.find('/');
@@ -321,6 +920,11 @@ class Communicator {
                   " has " + std::to_string(tail[2]) + " bytes, we have " +
                   std::to_string(stripe_floor_) +
                   " (TORCHFT_RING_FRAME_KB must be uniform)");
+            if (tail[0] >= lanes_)
+              throw CommError(
+                  "lane index out of range in hello from rank " +
+                  std::to_string(peer_rank) + ": lane " +
+                  std::to_string(tail[0]) + " >= " + std::to_string(lanes_));
             auto& fds = inbound[static_cast<int64_t>(peer_rank)];
             if (fds.size() < lanes_) fds.resize(lanes_, -1);
             fds[tail[0]] = conn;
@@ -384,7 +988,7 @@ class Communicator {
   }
 
   void abort() {
-    // Shut sockets down (don't close): an op thread may be mid-poll on these
+    // Shut sockets down (don't close): an op thread may be mid-IO on these
     // fds; shutdown unblocks its IO with errors while keeping fd numbers
     // valid.  close happens at destruction.
     aborted_ = true;
@@ -446,13 +1050,45 @@ class Communicator {
 
   int64_t rank() const { return rank_; }
   int64_t size() const { return world_size_; }
+  size_t lanes() const { return lanes_; }
+  size_t stripe_floor() const { return stripe_floor_; }
   void set_timeout(double t) { timeout_s_ = t; }
+
+  // per-lane observability counters of the current epoch (payload bytes
+  // moved + stall events: pacer denials / kernel would-block), the same
+  // counters TCPCommunicator.lane_stats() exports — surfaced through
+  // native.py so manager.last_quorum_timings is tier-agnostic.  Returns
+  // the lane count; fills up to `cap` entries per array.
+  size_t lane_stats(uint64_t* tx, uint64_t* rx, uint64_t* stalls,
+                    size_t cap) const {
+    IoPtr io = io_snapshot();
+    if (!io->tx) return 0;
+    for (size_t i = 0; i < std::min(io->lanes, cap); ++i) {
+      tx[i] = io->tx[i].load(std::memory_order_relaxed);
+      rx[i] = io->rx[i].load(std::memory_order_relaxed);
+      stalls[i] = io->stalls[i].load(std::memory_order_relaxed);
+    }
+    return io->lanes;
+  }
 
   // -- collectives (synchronous; caller provides an op thread) -------------
 
   // In-place ring allreduce over a contiguous buffer.
   void allreduce(void* data, size_t nbytes, DType dt, RedOp op) {
-    allreduce_ring(data, nbytes, dt, op, full_ring());
+    ScatterView view(data, nbytes);
+    allreduce_ring(view, dt, op, full_ring());
+  }
+
+  // In-place ring allreduce over MANY caller buffers treated as one
+  // logical payload — the zero-copy multi-array path: frames are sent with
+  // sendmsg straight from the callers' memory and received with recvmsg
+  // straight into it; the payload is never assembled in a staging copy.
+  // Every buffer must hold whole elements of `dt` (the Python binding
+  // groups arrays by dtype), so chunk math never splits an element.
+  void allreduce_iov(void* const* bufs, const uint64_t* lens, size_t n,
+                     DType dt, RedOp op) {
+    ScatterView view(bufs, lens, n);
+    allreduce_ring(view, dt, op, full_ring());
   }
 
   // Ring allreduce over a RANK SUBSET (global ranks in ring order) — the
@@ -462,16 +1098,30 @@ class Communicator {
   // same frames, so mixed-tier leader rings interoperate.
   void allreduce_ring(void* data, size_t nbytes, DType dt, RedOp op,
                       const std::vector<int64_t>& ring) {
+    ScatterView view(data, nbytes);
+    allreduce_ring(view, dt, op, ring);
+  }
+
+  void allreduce_ring(ScatterView& view, DType dt, RedOp op,
+                      const std::vector<int64_t>& ring) {
     if (ring.size() <= 1) return;
+    IoPtr io = io_snapshot();
     size_t esz = dtype_size(dt);
     auto deadline = deadline_in(timeout_s_);
-    auto bounds = ring_bounds(nbytes / esz, ring.size());
-    uint8_t* bytes = static_cast<uint8_t*>(data);
+    auto bounds = ring_bounds(view.size() / esz, ring.size());
 
-    // reduce-scatter phase with shift 0: position ends owning chunk pos+1
-    ring_reduce_phase(bytes, bounds, esz, dt, op, /*shift=*/0, deadline, ring);
-    // allgather phase with matching shift: first step sends the owned chunk
-    ring_allgather_phase(bytes, bounds, esz, /*shift=*/0, deadline, ring);
+    // shift -1 on BOTH phases: the Python tier's schedule (ring position p
+    // ends the reduce phase owning chunk p, the conventional contract —
+    // communicator._ring_reduce_scatter sends pos-step-1 / recvs
+    // pos-step-2, then allgather sends pos-step / recvs pos-step-1).  The
+    // round-1 build ran the textbook shift-0 schedule here: correct alone,
+    // but chunk indices landed rotated by one against a Python peer — a
+    // silent cross-tier corruption the constant-fill interop test never
+    // saw (mixed-tier bit-identity tests now pin this).
+    ring_reduce_phase(io, view, bounds, esz, dt, op, /*shift=*/-1, deadline,
+                      ring, /*tag_base=*/0);
+    ring_allgather_phase(io, view, bounds, esz, /*shift=*/-1, deadline, ring,
+                         /*tag_base=*/0);
   }
 
   // reduce-scatter: `data` is reduced in place ring-wise; this rank's chunk
@@ -487,10 +1137,13 @@ class Communicator {
     if (own_bytes > out_cap)
       throw CommError("reduce_scatter out buffer too small");
     if (world_size_ > 1) {
+      IoPtr io = io_snapshot();
       auto deadline = deadline_in(timeout_s_);
-      // shift -1: rank ends owning chunk `rank` (conventional contract)
-      ring_reduce_phase(bytes, bounds, esz, dt, op, /*shift=*/-1, deadline,
-                        full_ring());
+      ScatterView view(data, nbytes);
+      // shift -1: rank ends owning chunk `rank` (conventional contract);
+      // the explicit-API tag window keeps these frames clear of allreduce
+      ring_reduce_phase(io, view, bounds, esz, dt, op, /*shift=*/-1, deadline,
+                        full_ring(), kRingReduceTagBase);
     }
     std::memcpy(out, bytes + own_off, own_bytes);
     return own_bytes;
@@ -498,34 +1151,44 @@ class Communicator {
 
   void broadcast(void* data, size_t nbytes, int64_t root) {
     if (world_size_ <= 1) return;
+    IoPtr io = io_snapshot();
     auto deadline = deadline_in(timeout_s_);
     if (rank_ == root) {
       // concurrent fan-out to every peer (send-only multi_exchange)
-      const uint8_t* src = static_cast<const uint8_t*>(data);
+      uint8_t* src = static_cast<uint8_t*>(data);
       multi_exchange(
-          peers_snapshot(),
+          io, peers_snapshot(),
           [&](int64_t) { return std::make_pair(src, nbytes); },
           [&](int64_t) {
             return std::make_pair(static_cast<uint8_t*>(nullptr), size_t(0));
           },
           3000, deadline);
     } else {
-      recv_striped(peer_fds(root), root, 3000, data, nbytes, deadline);
+      ScatterView view(data, nbytes);
+      recv_striped(*io, peer_fds(root), root, 3000, view, 0, nbytes,
+                   deadline);
     }
   }
 
   void send(const void* data, size_t nbytes, int64_t dst, uint64_t tag) {
+    IoPtr io = io_snapshot();
     auto deadline = deadline_in(timeout_s_);
-    send_framed(p2p_fd(dst), dst, tag, data, nbytes, deadline);
+    std::vector<struct iovec> payload;
+    if (nbytes)
+      payload.push_back({const_cast<void*>(data), nbytes});
+    send_framed_iov(*io, p2p_fd(dst), dst, tag, std::move(payload), nbytes,
+                    deadline, io->lanes - 1);
   }
 
   // zero-copy: receive one frame directly into a caller buffer; returns
   // the payload size (must be <= cap)
   size_t recv_into(int64_t src, uint64_t tag, void* buf, size_t cap) {
+    IoPtr io = io_snapshot();
+    size_t p2p_lane = io->lanes - 1;
     auto deadline = deadline_in(timeout_s_);
     int fd = p2p_fd(src);
     uint64_t hdr[2];
-    recv_loop(fd, src, hdr, 16, deadline);
+    recv_loop(*io, fd, src, hdr, 16, deadline, p2p_lane);
     if (hdr[1] != tag)
       throw CommError("tag mismatch from rank " + std::to_string(src));
     if (hdr[0] > cap) {
@@ -534,26 +1197,28 @@ class Communicator {
       uint64_t remaining = hdr[0];
       while (remaining > 0) {
         size_t take = std::min<uint64_t>(remaining, scratch.size());
-        recv_loop(fd, src, scratch.data(), take, deadline);
+        recv_loop(*io, fd, src, scratch.data(), take, deadline, p2p_lane);
         remaining -= take;
       }
       throw CommError("recv_into buffer too small: payload " +
                       std::to_string(hdr[0]) + " > cap " + std::to_string(cap));
     }
-    recv_loop(fd, src, buf, hdr[0], deadline);
+    recv_loop(*io, fd, src, buf, hdr[0], deadline, p2p_lane);
     return hdr[0];
   }
 
   // receiver learns the size from the frame header
   std::vector<uint8_t> recv_dynamic(int64_t src, uint64_t tag) {
+    IoPtr io = io_snapshot();
+    size_t p2p_lane = io->lanes - 1;
     auto deadline = deadline_in(timeout_s_);
     int fd = p2p_fd(src);
     uint64_t hdr[2];
-    recv_loop(fd, src, hdr, 16, deadline);
+    recv_loop(*io, fd, src, hdr, 16, deadline, p2p_lane);
     if (hdr[1] != tag)
       throw CommError("tag mismatch from rank " + std::to_string(src));
     std::vector<uint8_t> out(hdr[0]);
-    recv_loop(fd, src, out.data(), out.size(), deadline);
+    recv_loop(*io, fd, src, out.data(), out.size(), deadline, p2p_lane);
     return out;
   }
 
@@ -561,13 +1226,26 @@ class Communicator {
   // `data` (ws chunks of chunk_bytes); received into `out` by source rank.
   void alltoall(const void* data, void* out, size_t chunk_bytes, uint64_t tag) {
     const uint8_t* in = static_cast<const uint8_t*>(data);
+    std::vector<const void*> ins(static_cast<size_t>(world_size_));
+    for (int64_t p = 0; p < world_size_; ++p) ins[p] = in + p * chunk_bytes;
+    alltoall_ptrs(ins.data(), out, chunk_bytes, tag);
+  }
+
+  // scatter-gather alltoall: one pointer per destination rank's chunk (the
+  // chunks need not be contiguous with each other — no staging concat)
+  void alltoall_ptrs(const void* const* ins, void* out, size_t chunk_bytes,
+                     uint64_t tag) {
     uint8_t* o = static_cast<uint8_t*>(out);
-    std::memcpy(o + rank_ * chunk_bytes, in + rank_ * chunk_bytes, chunk_bytes);
+    std::memcpy(o + rank_ * chunk_bytes, ins[rank_], chunk_bytes);
+    IoPtr io = io_snapshot();
     auto deadline = deadline_in(timeout_s_);
     // pairwise exchange with every peer concurrently
     multi_exchange(
-        peers_snapshot(),
-        [&](int64_t p) { return std::make_pair(in + p * chunk_bytes, chunk_bytes); },
+        io, peers_snapshot(),
+        [&](int64_t p) {
+          return std::make_pair(
+              static_cast<const uint8_t*>(ins[p]), chunk_bytes);
+        },
         [&](int64_t p) { return std::make_pair(o + p * chunk_bytes, chunk_bytes); },
         4000 + tag, deadline);
   }
@@ -576,9 +1254,10 @@ class Communicator {
     const uint8_t* in = static_cast<const uint8_t*>(data);
     uint8_t* o = static_cast<uint8_t*>(out);
     std::memcpy(o + rank_ * chunk_bytes, in, chunk_bytes);
+    IoPtr io = io_snapshot();
     auto deadline = deadline_in(timeout_s_);
     multi_exchange(
-        peers_snapshot(),
+        io, peers_snapshot(),
         [&](int64_t) { return std::make_pair(in, chunk_bytes); },
         [&](int64_t p) { return std::make_pair(o + p * chunk_bytes, chunk_bytes); },
         5000 + tag, deadline);
@@ -620,111 +1299,321 @@ class Communicator {
   // collective control frames concentrate; matches _TcpMesh.p2p_sock
   int p2p_fd(int64_t peer) { return peer_fd(peer, lanes_ - 1); }
 
+  std::shared_ptr<LanePool> pool_snapshot() {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    if (!pool_) throw CommError("communicator not configured");
+    return pool_;
+  }
+
   void check_abort() const {
     if (aborted_) throw CommError("communicator aborted");
   }
 
-  // --- blocking framed IO with abort/deadline checks per quantum ---------
+  IoPtr io_snapshot() const {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    return io_;
+  }
 
-  void send_framed(int fd, int64_t peer, uint64_t tag, const void* buf,
-                   size_t nbytes, TimePoint deadline) {
+  // --- scatter-gather framed IO with abort/deadline checks per quantum ----
+  //
+  // One frame = 16-byte header (payload nbytes, tag) + payload, where the
+  // payload may be scattered across many caller buffers: sendmsg pushes
+  // header + payload segments in one syscall/TCP segment (with TCP_NODELAY
+  // a separate header send costs a segment and a wakeup per frame), and
+  // recvmsg lands payload bytes straight in the callers' segments.
+
+  void send_framed_iov(EpochIO& io, int fd, int64_t peer, uint64_t tag,
+                       std::vector<struct iovec> payload, size_t nbytes,
+                       TimePoint deadline, size_t lane) {
+    io.gate();
     uint64_t hdr[2] = {nbytes, tag};
-    // writev: header + first payload bytes leave in ONE syscall/segment
-    // (with TCP_NODELAY a separate 16-byte header send costs a segment and
-    // a wakeup per frame)
-    struct iovec iov[2];
-    iov[0].iov_base = hdr;
-    iov[0].iov_len = 16;
-    iov[1].iov_base = const_cast<void*>(buf);
-    iov[1].iov_len = nbytes;
-    while (true) {
+    payload.insert(payload.begin(), {hdr, sizeof(hdr)});
+    IovCursor cursor(std::move(payload));
+    struct iovec batch[kMaxIovSegs + 1];
+    size_t hdr_left = sizeof(hdr);
+    while (cursor.remaining() > 0) {
       check_abort();
       if (now() > deadline) throw CommError("send timed out");
-      ssize_t sent = ::writev(fd, iov, 2);
-      if (sent < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+      size_t budget = cursor.remaining();
+      if (io.pacer && cursor.remaining() > hdr_left) {
+        // the header rides free (16 bytes of framing noise vs the Python
+        // tier's per-chunk accounting parity)
+        size_t want =
+            std::min(cursor.remaining() - hdr_left, size_t(1) << 20);
+        size_t allowed = io.pacer->allow(want, static_cast<uint64_t>(fd));
+        // coalesce dribbles: a cwnd-limited stream bucket refills a few
+        // tens of KB per scheduling quantum, and pushing each dribble
+        // costs a syscall + a wakeup PER LANE THREAD — on small hosts
+        // that thrash (not the token rate) becomes the ceiling.  Below
+        // the floor, nap briefly instead (tokens keep accruing while we
+        // sleep; nothing is consumed).
+        size_t floor =
+            std::min({want, kPaceMinSendBytes, io.pacer->max_grant() / 2});
+        if (allowed < floor) {
+          io.stall(lane);
+          std::this_thread::sleep_for(std::chrono::microseconds(200));
           continue;
+        }
+        budget = allowed + hdr_left;
+      }
+      int cnt = cursor.fill(batch, kMaxIovSegs + 1, budget);
+      if (cnt == 0) break;
+      struct msghdr msg {};
+      msg.msg_iov = batch;
+      msg.msg_iovlen = cnt;
+      ssize_t sent = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+      if (sent < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+          io.stall(lane);
+          continue;  // quantum expired: re-check abort/deadline
+        }
         throw CommError("send failed to rank " + std::to_string(peer));
       }
       size_t s = static_cast<size_t>(sent);
-      if (s >= iov[0].iov_len + iov[1].iov_len) return;
-      if (s >= iov[0].iov_len) {
-        // header fully out: finish the payload with the plain loop
-        size_t payload_sent = s - iov[0].iov_len;
-        send_loop(fd, peer, static_cast<const uint8_t*>(buf) + payload_sent,
-                  nbytes - payload_sent, deadline);
-        return;
+      size_t hdr_part = std::min(s, hdr_left);
+      hdr_left -= hdr_part;
+      if (io.pacer) io.pacer->consume(s - hdr_part, static_cast<uint64_t>(fd));
+      io.add_tx(lane, s - hdr_part);
+      cursor.advance(s);
+    }
+  }
+
+  void recv_loop_iov(EpochIO& io, int fd, int64_t peer, IovCursor& cursor,
+                     TimePoint deadline, size_t lane) {
+    struct iovec batch[kMaxIovSegs];
+    while (cursor.remaining() > 0) {
+      check_abort();
+      if (now() > deadline) throw CommError("recv timed out");
+      int cnt = cursor.fill(batch, kMaxIovSegs, cursor.remaining());
+      struct msghdr msg {};
+      msg.msg_iov = batch;
+      msg.msg_iovlen = cnt;
+      ssize_t got = ::recvmsg(fd, &msg, 0);
+      if (got == 0)
+        throw CommError("connection to rank " + std::to_string(peer) +
+                        " closed");
+      if (got < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+          continue;  // quantum expired: re-check abort/deadline
+        throw CommError("recv failed from rank " + std::to_string(peer));
       }
-      // partial header (rare): finish header then payload
-      send_loop(fd, peer, reinterpret_cast<uint8_t*>(hdr) + s, 16 - s,
-                deadline);
-      send_loop(fd, peer, buf, nbytes, deadline);
-      return;
+      io.add_rx(lane, static_cast<size_t>(got));
+      cursor.advance(static_cast<size_t>(got));
     }
   }
 
   // --- lane-striped framed IO ---------------------------------------------
   //
   // One logical frame split across the lane connections by lane_parts();
-  // part 0 runs on the calling thread, the rest on short-lived lane
-  // threads, so on cwnd-limited links the streams genuinely run in
-  // parallel.  Sub-frame boundaries are 64-byte aligned, so the reduce
+  // part 0 runs on the calling thread, the rest on the epoch's persistent
+  // per-lane workers, so on cwnd-limited links the streams genuinely run
+  // in parallel.  Sub-frame boundaries are 64-byte aligned, so the reduce
   // variant can fold each lane's range independently — every element still
   // sees exactly one reduction per step: results are bit-identical to a
   // single lane.
 
   template <typename PartFn>
-  void run_lane_parts(const std::vector<std::pair<size_t, size_t>>& parts,
+  void run_lane_parts(int64_t peer, int dir,
+                      const std::vector<std::pair<size_t, size_t>>& parts,
                       PartFn fn) {
     if (parts.size() == 1) {
       fn(0, parts[0].first, parts[0].second);
       return;
     }
-    std::mutex err_mu;
-    std::string first_err;
-    std::vector<std::thread> threads;
+    auto pool = pool_snapshot();
+    auto latch = std::make_shared<OpLatch>();
+    latch->add(parts.size() - 1);
     for (size_t i = 1; i < parts.size(); ++i) {
-      threads.emplace_back([&, i] {
+      size_t s = parts[i].first, e = parts[i].second;
+      pool->submit(peer, i, dir, [&fn, i, s, e, latch] {
+        std::string err;
         try {
-          fn(i, parts[i].first, parts[i].second);
-        } catch (const std::exception& e) {
-          std::lock_guard<std::mutex> lock(err_mu);
-          if (first_err.empty()) first_err = e.what();
+          fn(i, s, e);
+        } catch (const std::exception& ex) {
+          err = ex.what();
         }
+        latch->done(err);
       });
     }
+    std::string err0;
     try {
       fn(0, parts[0].first, parts[0].second);
-    } catch (const std::exception& e) {
-      std::lock_guard<std::mutex> lock(err_mu);
-      if (first_err.empty()) first_err = e.what();
+    } catch (const std::exception& ex) {
+      err0 = ex.what();
     }
-    for (auto& t : threads) t.join();
-    if (!first_err.empty()) throw CommError(first_err);
+    std::string err = latch->wait_quiet();
+    if (!err0.empty()) throw CommError(err0);
+    if (!err.empty()) throw CommError(err);
   }
 
-  void send_striped(const std::vector<int>& fds, int64_t peer, uint64_t tag,
-                    const void* buf, size_t nbytes, TimePoint deadline) {
-    const uint8_t* base = static_cast<const uint8_t*>(buf);
-    run_lane_parts(lane_parts(nbytes), [&](size_t lane, size_t s, size_t e) {
-      send_framed(fds[lane], peer, tag, base + s, e - s, deadline);
-    });
+  // striped send of view[off, off+nbytes) to peer, synchronous
+  void send_striped(EpochIO& io, const std::vector<int>& fds, int64_t peer,
+                    uint64_t tag, const ScatterView& view, size_t off,
+                    size_t nbytes, TimePoint deadline) {
+    auto parts = io.lane_parts(nbytes);
+    if (io.pacer && parts.size() > 1) {
+      // paced striped sends multiplex every lane on ONE thread: under a
+      // token bucket the wire, not the CPU, is the bottleneck, and a
+      // round-robin writer (exactly the Python select loop's shape)
+      // saturates all cwnd-capped streams without n napping threads
+      // fighting the scheduler on small hosts
+      send_striped_multiplexed(io, fds, peer, tag, view, off, parts,
+                               deadline);
+      return;
+    }
+    run_lane_parts(peer, LanePool::kTx, parts,
+                   [&](size_t lane, size_t s, size_t e) {
+                     send_framed_iov(io, fds[lane], peer, tag,
+                                     view.slice(off + s, e - s), e - s,
+                                     deadline, lane);
+                   });
   }
 
-  void recv_striped(const std::vector<int>& fds, int64_t peer, uint64_t tag,
-                    void* buf, size_t nbytes, TimePoint deadline) {
-    uint8_t* base = static_cast<uint8_t*>(buf);
-    run_lane_parts(lane_parts(nbytes), [&](size_t lane, size_t s, size_t e) {
-      recv_framed(fds[lane], peer, tag, base + s, e - s, deadline);
-    });
+  // one thread drives every lane's sub-frame of a striped send,
+  // round-robining the pacer grants; wire bytes are identical to the
+  // per-lane-thread path (same frames on the same lanes, interleaving is
+  // invisible to per-connection TCP streams)
+  void send_striped_multiplexed(
+      EpochIO& io, const std::vector<int>& fds, int64_t peer, uint64_t tag,
+      const ScatterView& view, size_t off,
+      const std::vector<std::pair<size_t, size_t>>& parts,
+      TimePoint deadline) {
+    io.gate();  // one gate arms every lane, like the Python loop
+    struct LaneTx {
+      int fd = -1;
+      size_t lane = 0;
+      uint64_t hdr[2] = {0, 0};
+      IovCursor cursor;
+      size_t hdr_left = sizeof(hdr);
+    };
+    std::vector<std::unique_ptr<LaneTx>> lanes;
+    for (size_t i = 0; i < parts.size(); ++i) {
+      size_t s = parts[i].first, e = parts[i].second;
+      auto lt = std::make_unique<LaneTx>();
+      lt->fd = fds[i];
+      lt->lane = i;
+      lt->hdr[0] = e - s;
+      lt->hdr[1] = tag;
+      auto iov = view.slice(off + s, e - s);
+      // the header iovec points at THIS LaneTx's hdr storage
+      iov.insert(iov.begin(), {lt->hdr, sizeof(lt->hdr)});
+      lt->cursor = IovCursor(std::move(iov));
+      lanes.push_back(std::move(lt));
+    }
+    struct iovec batch[kMaxIovSegs + 1];
+    size_t live = lanes.size();
+    while (live > 0) {
+      check_abort();
+      if (now() > deadline) throw CommError("send timed out");
+      bool progressed = false;
+      for (auto& lt : lanes) {
+        if (lt->cursor.remaining() == 0) continue;
+        size_t remaining = lt->cursor.remaining();
+        size_t payload_left = remaining - lt->hdr_left;
+        size_t budget = remaining;
+        if (payload_left > 0) {
+          size_t want = std::min(payload_left, size_t(1) << 20);
+          size_t allowed =
+              io.pacer->allow(want, static_cast<uint64_t>(lt->fd));
+          size_t floor =
+              std::min({want, kPaceMinSendBytes, io.pacer->max_grant() / 2});
+          if (allowed < floor) {
+            io.stall(lt->lane);
+            continue;  // this lane is token-blocked; try the next
+          }
+          budget = allowed + lt->hdr_left;
+        }
+        int cnt = lt->cursor.fill(batch, kMaxIovSegs + 1, budget);
+        if (cnt == 0) continue;
+        struct msghdr msg {};
+        msg.msg_iov = batch;
+        msg.msg_iovlen = cnt;
+        ssize_t sent = ::sendmsg(lt->fd, &msg, MSG_NOSIGNAL);
+        if (sent < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+            io.stall(lt->lane);
+            continue;
+          }
+          throw CommError("send failed to rank " + std::to_string(peer));
+        }
+        size_t s2 = static_cast<size_t>(sent);
+        size_t hdr_part = std::min(s2, lt->hdr_left);
+        lt->hdr_left -= hdr_part;
+        io.pacer->consume(s2 - hdr_part, static_cast<uint64_t>(lt->fd));
+        io.add_tx(lt->lane, s2 - hdr_part);
+        lt->cursor.advance(s2);
+        progressed = true;
+        if (lt->cursor.remaining() == 0) --live;
+      }
+      if (!progressed && live > 0)
+        std::this_thread::sleep_for(std::chrono::microseconds(200));
+    }
   }
 
-  void recv_striped_reduce(const std::vector<int>& fds, int64_t peer,
-                           uint64_t tag, void* dst, size_t nbytes, DType dt,
-                           RedOp op, TimePoint deadline,
+  // striped send dispatched entirely onto the per-lane tx workers; the
+  // returned latch completes when every part is on the wire (the ring's
+  // duplex steps run send and recv concurrently without a thread spawn)
+  std::shared_ptr<OpLatch> send_striped_async(
+      IoPtr io, const std::vector<int>& fds, int64_t peer, uint64_t tag,
+      const ScatterView& view, size_t off, size_t nbytes, TimePoint deadline) {
+    auto pool = pool_snapshot();
+    auto latch = std::make_shared<OpLatch>();
+    auto parts = io->lane_parts(nbytes);
+    if (io->pacer && parts.size() > 1) {
+      // paced: one multiplexer task round-robins every lane (see
+      // send_striped) instead of a napping worker per lane
+      latch->add(1);
+      pool->submit(peer, 0, LanePool::kTx,
+                   [this, io, fds, peer, tag, &view, off, parts, deadline,
+                    latch] {
+                     std::string err;
+                     try {
+                       send_striped_multiplexed(*io, fds, peer, tag, view,
+                                                off, parts, deadline);
+                     } catch (const std::exception& ex) {
+                       err = ex.what();
+                     }
+                     latch->done(err);
+                   });
+      return latch;
+    }
+    latch->add(parts.size());
+    for (size_t i = 0; i < parts.size(); ++i) {
+      size_t s = parts[i].first, e = parts[i].second;
+      int fd = fds[i];
+      pool->submit(peer, i, LanePool::kTx,
+                   [this, io, fd, peer, tag, &view, off, s, e, deadline,
+                    latch, i] {
+                     std::string err;
+                     try {
+                       send_framed_iov(*io, fd, peer, tag,
+                                       view.slice(off + s, e - s), e - s,
+                                       deadline, i);
+                     } catch (const std::exception& ex) {
+                       err = ex.what();
+                     }
+                     latch->done(err);
+                   });
+    }
+    return latch;
+  }
+
+  void recv_striped(EpochIO& io, const std::vector<int>& fds, int64_t peer,
+                    uint64_t tag, ScatterView& view, size_t off,
+                    size_t nbytes, TimePoint deadline) {
+    run_lane_parts(peer, LanePool::kRx, io.lane_parts(nbytes),
+                   [&](size_t lane, size_t s, size_t e) {
+                     recv_framed_iov(io, fds[lane], peer, tag, view, off + s,
+                                     e - s, deadline, lane);
+                   });
+  }
+
+  void recv_striped_reduce(EpochIO& io, const std::vector<int>& fds,
+                           int64_t peer, uint64_t tag, ScatterView& view,
+                           size_t off, size_t nbytes, DType dt, RedOp op,
+                           TimePoint deadline,
                            std::vector<std::vector<uint8_t>>& scratches) {
-    uint8_t* base = static_cast<uint8_t*>(dst);
-    auto parts = lane_parts(nbytes);
+    auto parts = io.lane_parts(nbytes);
     // per-lane scratch from the caller's pool (grown once, reused across
     // ring steps): the quantum-pipelined reduce runs concurrently on every
     // lane over disjoint destination ranges
@@ -735,10 +1624,13 @@ class Communicator {
           64;
       if (scratches[i].size() < want) scratches[i].resize(want);
     }
-    run_lane_parts(parts, [&](size_t lane, size_t s, size_t e) {
-      recv_framed_reduce(fds[lane], peer, tag, base + s, e - s,
-                         scratches[lane].data(), dt, op, deadline);
-    });
+    run_lane_parts(peer, LanePool::kRx, parts,
+                   [&](size_t lane, size_t s, size_t e) {
+                     recv_framed_reduce(io, fds[lane], peer, tag, view,
+                                        off + s, e - s,
+                                        scratches[lane].data(), dt, op,
+                                        deadline, lane);
+                   });
   }
 
   // element bounds per ring chunk (first n%ws chunks one element longer)
@@ -770,18 +1662,21 @@ class Communicator {
   // ring reduce phase: ws-1 duplex steps over `ring` (global ranks in ring
   // order; ws = ring.size()); with shift s, this rank's ring POSITION ends
   // up owning the fully-reduced chunk (pos + 1 + s) mod ws.  The (memory-
-  // bound) reduction rides under the wire via quantum-pipelined recv.
-  void ring_reduce_phase(uint8_t* bytes, const std::vector<size_t>& bounds,
-                         size_t esz, DType dt, RedOp op, int64_t shift,
-                         TimePoint deadline,
-                         const std::vector<int64_t>& ring) {
+  // bound) reduction rides under the wire via quantum-pipelined recv; the
+  // send leg runs on the per-lane tx workers, the recv leg on the calling
+  // thread + rx workers.
+  void ring_reduce_phase(IoPtr io, ScatterView& view,
+                         const std::vector<size_t>& bounds, size_t esz,
+                         DType dt, RedOp op, int64_t shift,
+                         TimePoint deadline, const std::vector<int64_t>& ring,
+                         uint64_t tag_base) {
     int64_t ws = static_cast<int64_t>(ring.size());
     int64_t pos = ring_pos(ring, rank_);
     int64_t right = ring[(pos + 1) % ws];
     int64_t left = ring[(pos - 1 + ws) % ws];
-    auto chunk_ptr = [&](int64_t i) {
+    auto chunk_off = [&](int64_t i) {
       i = ((i % ws) + ws) % ws;
-      return bytes + bounds[i] * esz;
+      return bounds[i] * esz;
     };
     auto chunk_bytes = [&](int64_t i) {
       i = ((i % ws) + ws) % ws;
@@ -793,41 +1688,37 @@ class Communicator {
     for (int64_t step = 0; step < ws - 1; ++step) {
       int64_t send_idx = pos - step + shift;
       int64_t recv_idx = pos - step - 1 + shift;
-      std::string send_err;
-      std::thread sender([&] {
-        try {
-          send_striped(right_fds, right, 1000 + step, chunk_ptr(send_idx),
-                       chunk_bytes(send_idx), deadline);
-        } catch (const std::exception& e) {
-          send_err = e.what();
-        }
-      });
+      auto send_latch =
+          send_striped_async(io, right_fds, right, tag_base + 1000 + step,
+                             view, chunk_off(send_idx), chunk_bytes(send_idx),
+                             deadline);
       try {
-        recv_striped_reduce(left_fds, left, 1000 + step, chunk_ptr(recv_idx),
-                            chunk_bytes(recv_idx), dt, op, deadline,
-                            scratches);
+        recv_striped_reduce(*io, left_fds, left, tag_base + 1000 + step, view,
+                            chunk_off(recv_idx), chunk_bytes(recv_idx), dt, op,
+                            deadline, scratches);
       } catch (...) {
-        sender.join();
+        send_latch->wait_quiet();
         throw;
       }
-      sender.join();
-      if (!send_err.empty()) throw CommError(send_err);
+      send_latch->wait();
     }
   }
 
   // ring allgather phase: ws-1 duplex steps circulating the fully-reduced
   // chunks over `ring`; with shift s, this rank's ring position starts
   // owning chunk (pos + 1 + s) mod ws.
-  void ring_allgather_phase(uint8_t* bytes, const std::vector<size_t>& bounds,
-                            size_t esz, int64_t shift, TimePoint deadline,
-                            const std::vector<int64_t>& ring) {
+  void ring_allgather_phase(IoPtr io, ScatterView& view,
+                            const std::vector<size_t>& bounds, size_t esz,
+                            int64_t shift, TimePoint deadline,
+                            const std::vector<int64_t>& ring,
+                            uint64_t tag_base) {
     int64_t ws = static_cast<int64_t>(ring.size());
     int64_t pos = ring_pos(ring, rank_);
     int64_t right = ring[(pos + 1) % ws];
     int64_t left = ring[(pos - 1 + ws) % ws];
-    auto chunk_ptr = [&](int64_t i) {
+    auto chunk_off = [&](int64_t i) {
       i = ((i % ws) + ws) % ws;
-      return bytes + bounds[i] * esz;
+      return bounds[i] * esz;
     };
     auto chunk_bytes = [&](int64_t i) {
       i = ((i % ws) + ws) % ws;
@@ -838,81 +1729,63 @@ class Communicator {
     for (int64_t step = 0; step < ws - 1; ++step) {
       int64_t send_idx = pos + 1 + shift - step;
       int64_t recv_idx = pos + shift - step;
-      std::string send_err;
-      std::thread sender([&] {
-        try {
-          send_striped(right_fds, right, 2000 + step, chunk_ptr(send_idx),
-                       chunk_bytes(send_idx), deadline);
-        } catch (const std::exception& e) {
-          send_err = e.what();
-        }
-      });
+      auto send_latch =
+          send_striped_async(io, right_fds, right, tag_base + 2000 + step,
+                             view, chunk_off(send_idx), chunk_bytes(send_idx),
+                             deadline);
       try {
-        recv_striped(left_fds, left, 2000 + step, chunk_ptr(recv_idx),
+        recv_striped(*io, left_fds, left, tag_base + 2000 + step, view,
+                     chunk_off(recv_idx),
                      chunk_bytes(recv_idx), deadline);
       } catch (...) {
-        sender.join();
+        send_latch->wait_quiet();
         throw;
       }
-      sender.join();
-      if (!send_err.empty()) throw CommError(send_err);
+      send_latch->wait();
     }
   }
 
-  // recv a frame in quanta, reducing each quantum into `dst` as it arrives
-  // (TCP delivers in order, so progressive reduction needs only a
+  // recv a frame in quanta, reducing each quantum into the view as it
+  // arrives (TCP delivers in order, so progressive reduction needs only a
   // quantum-sized scratch and overlaps compute with the wire)
-  void recv_framed_reduce(int fd, int64_t peer, uint64_t tag, void* dst,
-                          size_t nbytes, uint8_t* scratch, DType dt, RedOp op,
-                          TimePoint deadline) {
+  void recv_framed_reduce(EpochIO& io, int fd, int64_t peer, uint64_t tag,
+                          ScatterView& view, size_t dst_off, size_t nbytes,
+                          uint8_t* scratch, DType dt, RedOp op,
+                          TimePoint deadline, size_t lane) {
     static constexpr size_t kQuantum = size_t(4) << 20;
     uint64_t hdr[2];
-    recv_loop(fd, peer, hdr, 16, deadline);
+    recv_loop(io, fd, peer, hdr, 16, deadline, lane, /*count=*/false);
     if (hdr[1] != tag)
       throw CommError("tag mismatch from rank " + std::to_string(peer));
     if (hdr[0] != nbytes)
       throw CommError("size mismatch from rank " + std::to_string(peer));
     size_t esz = dtype_size(dt);
     size_t quantum = kQuantum - (kQuantum % (esz ? esz : 1));
-    uint8_t* d = static_cast<uint8_t*>(dst);
     size_t off = 0;
     while (off < nbytes) {
       size_t take = std::min(quantum, nbytes - off);
-      recv_loop(fd, peer, scratch, take, deadline);
-      reduce_buffer(d + off, scratch, take, dt, op);
+      recv_loop(io, fd, peer, scratch, take, deadline, lane);
+      view.reduce_in(dst_off + off, scratch, take, dt, op);
       off += take;
     }
   }
 
-  void recv_framed(int fd, int64_t peer, uint64_t tag, void* buf,
-                   size_t nbytes, TimePoint deadline) {
+  // recv one frame straight into the view's segments (zero staging copy)
+  void recv_framed_iov(EpochIO& io, int fd, int64_t peer, uint64_t tag,
+                       ScatterView& view, size_t dst_off, size_t nbytes,
+                       TimePoint deadline, size_t lane) {
     uint64_t hdr[2];
-    recv_loop(fd, peer, hdr, 16, deadline);
+    recv_loop(io, fd, peer, hdr, 16, deadline, lane, /*count=*/false);
     if (hdr[1] != tag)
       throw CommError("tag mismatch from rank " + std::to_string(peer));
     if (hdr[0] != nbytes)
       throw CommError("size mismatch from rank " + std::to_string(peer));
-    recv_loop(fd, peer, buf, nbytes, deadline);
+    IovCursor cursor(view.slice(dst_off, nbytes));
+    recv_loop_iov(io, fd, peer, cursor, deadline, lane);
   }
 
-  void send_loop(int fd, int64_t peer, const void* buf, size_t n,
-                 TimePoint deadline) {
-    const uint8_t* p = static_cast<const uint8_t*>(buf);
-    while (n > 0) {
-      check_abort();
-      if (now() > deadline) throw CommError("send timed out");
-      ssize_t sent = ::send(fd, p, n, MSG_NOSIGNAL);
-      if (sent < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
-          continue;  // quantum expired: re-check abort/deadline
-        throw CommError("send failed to rank " + std::to_string(peer));
-      }
-      p += sent;
-      n -= static_cast<size_t>(sent);
-    }
-  }
-
-  void recv_loop(int fd, int64_t peer, void* buf, size_t n, TimePoint deadline) {
+  void recv_loop(EpochIO& io, int fd, int64_t peer, void* buf, size_t n,
+                 TimePoint deadline, size_t lane, bool count = true) {
     uint8_t* p = static_cast<uint8_t*>(buf);
     while (n > 0) {
       check_abort();
@@ -925,67 +1798,81 @@ class Communicator {
           continue;  // quantum expired: re-check abort/deadline
         throw CommError("recv failed from rank " + std::to_string(peer));
       }
+      if (count) io.add_rx(lane, static_cast<size_t>(got));
       p += got;
       n -= static_cast<size_t>(got);
     }
   }
 
   // all-peers concurrent exchange (alltoall/allgather/broadcast fan-out):
-  // one duplex worker per peer, each leg lane-striped.
+  // one duplex leg per peer on the persistent lane workers (the (peer, 0)
+  // tx/rx pair coordinates; parts >= 1 fan out to that peer's lane
+  // workers), each leg lane-striped.
   template <typename SendFn, typename RecvFn>
-  void multi_exchange(const std::map<int64_t, std::vector<int>>& peers,
+  void multi_exchange(IoPtr io,
+                      const std::map<int64_t, std::vector<int>>& peers,
                       SendFn send_for, RecvFn recv_for, uint64_t tag,
                       TimePoint deadline) {
-    std::vector<std::thread> workers;
-    std::mutex err_mu;
-    std::string first_err;
-    for (const auto& [peer, fds] : peers) {
-      auto [sb, sn] = send_for(peer);
-      auto [rb, rn] = recv_for(peer);
-      workers.emplace_back([this, peer = peer, fds = fds, sb, sn, rb, rn, tag,
-                            deadline, &err_mu, &first_err] {
-        try {
-          if (rb == nullptr) {
-            send_striped(fds, peer, tag, sb, sn, deadline);
-            return;
-          }
-          std::string send_err;
-          std::thread sender([&] {
-            try {
-              send_striped(fds, peer, tag, sb, sn, deadline);
-            } catch (const std::exception& e) {
-              send_err = e.what();
-            }
-          });
-          try {
-            recv_striped(fds, peer, tag, rb, rn, deadline);
-          } catch (const std::exception& e) {
-            sender.join();
-            throw CommError(e.what());
-          }
-          sender.join();
-          if (!send_err.empty()) throw CommError(send_err);
-        } catch (const std::exception& e) {
-          std::lock_guard<std::mutex> lock(err_mu);
-          if (first_err.empty()) first_err = e.what();
-        }
-      });
+    auto pool = pool_snapshot();
+    auto latch = std::make_shared<OpLatch>();
+    std::vector<std::function<void()>> legs;
+    for (const auto& entry : peers) {
+      // plain locals (not structured bindings): C++17 lambdas cannot
+      // portably capture the latter
+      int64_t peer = entry.first;
+      std::vector<int> pfds = entry.second;
+      auto send_pair = send_for(peer);
+      auto recv_pair = recv_for(peer);
+      const uint8_t* sb = send_pair.first;
+      size_t sn = send_pair.second;
+      uint8_t* rb = recv_pair.first;
+      size_t rn = recv_pair.second;
+      latch->add(1);
+      pool->submit(peer, 0, LanePool::kTx,
+                   [this, io, pfds, peer, tag, sb, sn, deadline, latch] {
+                     std::string err;
+                     try {
+                       ScatterView sv(const_cast<uint8_t*>(sb), sn);
+                       send_striped(*io, pfds, peer, tag, sv, 0, sn,
+                                    deadline);
+                     } catch (const std::exception& ex) {
+                       err = ex.what();
+                     }
+                     latch->done(err);
+                   });
+      if (rb != nullptr) {
+        latch->add(1);
+        pool->submit(peer, 0, LanePool::kRx,
+                     [this, io, pfds, peer, tag, rb, rn, deadline, latch] {
+                       std::string err;
+                       try {
+                         ScatterView rv(rb, rn);
+                         recv_striped(*io, pfds, peer, tag, rv, 0, rn,
+                                      deadline);
+                       } catch (const std::exception& ex) {
+                         err = ex.what();
+                       }
+                       latch->done(err);
+                     });
+      }
     }
-    for (auto& w : workers) w.join();
-    if (!first_err.empty()) throw CommError(first_err);
+    latch->wait();
   }
 
   double timeout_s_;
   int64_t rank_ = 0;
   int64_t world_size_ = 1;
   size_t lanes_ = 1;
-  size_t stripe_floor_ = size_t(64) << 10;
+  size_t stripe_floor_ = kMinStripeBytes;
   std::atomic<bool> aborted_{false};
-  // guards peers_/graveyard_ STRUCTURE only — never held across IO; ops
-  // snapshot the fds they need at entry (fds stay open until destruction,
-  // so a snapshot can never dangle)
+  // guards peers_/graveyard_/pool_/io_ STRUCTURE only — never held across
+  // IO; ops snapshot the fds/pool/io they need at entry (fds stay open
+  // until destruction, so a snapshot can never dangle; superseded pools
+  // and EpochIO instances park in shared_ptrs held by in-flight ops)
   mutable std::mutex state_mu_;
   std::map<int64_t, std::vector<int>> peers_;
+  std::shared_ptr<LanePool> pool_;
+  IoPtr io_;
   std::vector<int> graveyard_;
 };
 

@@ -461,6 +461,95 @@ class TestNativeMirror:
         findings = nativemirror.check_wire_header(text, "native/wire.h")
         assert any(f.symbol == "kMaxFrameBytes" for f in findings)
 
+    def test_drifted_iovec_cap_flagged(self):
+        text = "constexpr size_t kMaxIovSegs = 8;\n"
+        findings = nativemirror.check_comm_header(text, "native/comm.h")
+        assert any(
+            f.symbol == "kMaxIovSegs" and "8" in f.message for f in findings
+        )
+
+    def test_missing_iovec_cap_flagged(self):
+        findings = nativemirror.check_comm_header("// empty\n", "native/comm.h")
+        assert any(f.symbol == "kMaxIovSegs" for f in findings)
+
+    def test_drifted_ring_reduce_tag_base_flagged(self):
+        text = "constexpr uint64_t kRingReduceTagBase = 40000;\n"
+        findings = nativemirror.check_comm_header(text, "native/comm.h")
+        assert any(
+            f.symbol == "kRingReduceTagBase" and "40000" in f.message
+            for f in findings
+        )
+
+    def test_missing_pacer_knob_flagged(self):
+        # references three of the four _NetEmu knobs: the missing one fires
+        text = (
+            'std::getenv("TORCHFT_NET_EMU");\n'
+            'std::getenv("TORCHFT_NET_GBPS");\n'
+            'std::getenv("TORCHFT_NET_RTT_MS");\n'
+        )
+        findings = nativemirror.check_comm_header(text, "native/comm.h")
+        symbols = {f.symbol for f in findings}
+        assert "pacer.TORCHFT_NET_CWND_KB" in symbols
+        assert "pacer.TORCHFT_NET_EMU" not in symbols
+
+    def test_drifted_pacer_profile_flagged(self):
+        text = (
+            "constexpr NetProfile kNetEmuProfiles[] = {\n"
+            '    {"wan_1g", 2.0, 10.0},\n'  # drifted gbps
+            '    {"wan_1g_10ms", 1.0, 10.0},\n'
+            '    {"dcn_10g", 10.0, 2.0},\n'
+            '    {"dcn_10g_2ms", 10.0, 2.0},\n'
+            '    {"loopback", 0.0, 0.0},\n'
+            "};\n"
+        )
+        findings = nativemirror.check_comm_header(text, "native/comm.h")
+        assert any(
+            f.symbol == "pacer.profile.wan_1g" and "2.0" in f.message
+            for f in findings
+        )
+
+    def test_unknown_native_profile_flagged(self):
+        text = (
+            "constexpr NetProfile kNetEmuProfiles[] = {\n"
+            '    {"wan_1g", 1.0, 10.0},\n'
+            '    {"wan_1g_10ms", 1.0, 10.0},\n'
+            '    {"dcn_10g", 10.0, 2.0},\n'
+            '    {"dcn_10g_2ms", 10.0, 2.0},\n'
+            '    {"loopback", 0.0, 0.0},\n'
+            '    {"moon_link", 0.001, 2500.0},\n'
+            "};\n"
+        )
+        findings = nativemirror.check_comm_header(text, "native/comm.h")
+        assert any(
+            f.symbol == "pacer.profile.moon_link" for f in findings
+        )
+
+    def test_missing_lane_counter_flagged(self):
+        text = "uint64_t lane_tx_bytes_[4];\nuint64_t lane_rx_bytes_[4];\n"
+        findings = nativemirror.check_comm_header(text, "native/comm.h")
+        symbols = {f.symbol for f in findings}
+        assert "counter.lane_stalls" in symbols
+        assert "counter.lane_tx_bytes" not in symbols
+
+    def test_binding_missing_lane_stats_key_flagged(self):
+        text = (
+            "_MAX_IOV_SEGS = 64\n"
+            'stats = {"lanes": 1, "stripe_floor_bytes": 2,\n'
+            ' "lane_tx_bytes": [], "lane_rx_bytes": []}\n'
+        )
+        findings = nativemirror.check_binding(text, "torchft_tpu/native.py")
+        symbols = {f.symbol for f in findings}
+        assert "lane_stats.lane_stalls" in symbols
+        assert "lane_stats.lanes" not in symbols
+
+    def test_binding_missing_iov_constant_flagged(self):
+        findings = nativemirror.check_binding(
+            '"lanes" "stripe_floor_bytes" "lane_tx_bytes" '
+            '"lane_rx_bytes" "lane_stalls"\n',
+            "torchft_tpu/native.py",
+        )
+        assert any(f.symbol == "_MAX_IOV_SEGS" for f in findings)
+
     def test_real_headers_mirror_python(self):
         findings = nativemirror.check(REPO)
         assert findings == [], "\n".join(f.render() for f in findings)

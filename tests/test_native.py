@@ -423,6 +423,358 @@ class TestCppCommunicator:
         np.testing.assert_allclose(recovered[0], np.full(4, 2.0))
 
 
+def _run_mixed_ranks(
+    store,
+    world_size: int,
+    cpp_ranks: set,
+    fn: Callable,
+    prefix: str,
+    timeout_s: float = 60.0,
+) -> List[object]:
+    """One rendezvous mixing tiers: ranks in ``cpp_ranks`` run the native
+    communicator, the rest the Python one."""
+    from torchft_tpu.communicator import TCPCommunicator
+
+    def _one(rank: int) -> object:
+        if rank in cpp_ranks:
+            comm = native.CppCommunicator(timeout_s=timeout_s)
+        else:
+            comm = TCPCommunicator(timeout_s=timeout_s)
+        comm.configure(
+            f"127.0.0.1:{store.port}/{prefix}",
+            replica_id=f"r{rank}",
+            rank=rank,
+            world_size=world_size,
+        )
+        try:
+            return fn(comm, rank)
+        finally:
+            comm.shutdown()
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        return list(pool.map(_one, range(world_size)))
+
+
+class TestMixedTierMesh:
+    """A cpp-tier rank among python-tier ranks in ONE rendezvous: the data
+    plane is one wire contract — results must be BIT-identical to an
+    all-python mesh at any lane count and wire kind (the ring schedule,
+    lane splits, and reduction order are all mirrored math)."""
+
+    @pytest.mark.parametrize("world_size", [2, 3])
+    @pytest.mark.parametrize("lanes", [1, 2, 4])
+    def test_f32_collectives_bit_identical(
+        self, cpp_store, world_size, lanes, monkeypatch
+    ) -> None:
+        monkeypatch.setenv("TORCHFT_RING_LANES", str(lanes))
+        monkeypatch.setenv("TORCHFT_RING_FRAME_KB", "64")
+        n = 100_003  # ~400KB: stripes at 2+ lanes, uneven ring chunks
+
+        def _ops(comm, rank):
+            rng = np.random.default_rng(1000 + rank)
+            data = rng.normal(size=n).astype(np.float32)
+            ar = comm.allreduce(data.copy(), ReduceOp.SUM).wait(timeout=60.0)
+            rs = comm.reduce_scatter(data.copy(), ReduceOp.SUM).wait(
+                timeout=60.0
+            )
+            ag = comm.allgather(data[:1001].copy()).wait(timeout=60.0)
+            return np.asarray(ar), np.asarray(rs), [np.asarray(g) for g in ag]
+
+        mixed = _run_mixed_ranks(
+            cpp_store,
+            world_size,
+            {world_size - 1},
+            _ops,
+            f"mix_{world_size}_{lanes}",
+        )
+        ref = _run_mixed_ranks(
+            cpp_store, world_size, set(), _ops, f"ref_{world_size}_{lanes}"
+        )
+        for rank, (got, want) in enumerate(zip(mixed, ref)):
+            np.testing.assert_array_equal(
+                got[0], want[0], err_msg=f"allreduce diverged on rank {rank}"
+            )
+            np.testing.assert_array_equal(
+                got[1],
+                want[1],
+                err_msg=f"reduce_scatter diverged on rank {rank}",
+            )
+            for src, (g, w) in enumerate(zip(got[2], want[2])):
+                np.testing.assert_array_equal(
+                    g,
+                    w,
+                    err_msg=f"allgather[{src}] diverged on rank {rank}",
+                )
+
+    @pytest.mark.parametrize("world_size", [2, 3])
+    @pytest.mark.parametrize("lanes", [1, 2])
+    def test_int8_wire_bit_identical(
+        self, cpp_store, world_size, lanes, monkeypatch
+    ) -> None:
+        """The quantized (int8 wire) pipeline rides alltoall/allgather —
+        same bytes through either tier's transport, bit-identical results."""
+        from torchft_tpu.collectives import allreduce_quantized
+
+        monkeypatch.setenv("TORCHFT_RING_LANES", str(lanes))
+        monkeypatch.setenv("TORCHFT_RING_FRAME_KB", "64")
+        monkeypatch.setenv("TORCHFT_QUANT_DEVICE_REDUCE", "0")
+        n = 64 * 1024  # whole quantization rows
+
+        def _ops(comm, rank):
+            rng = np.random.default_rng(2000 + rank)
+            data = rng.normal(size=n).astype(np.float32)
+            out = allreduce_quantized(comm, data.copy()).wait(timeout=60.0)
+            return np.asarray(out)
+
+        mixed = _run_mixed_ranks(
+            cpp_store,
+            world_size,
+            {world_size - 1},
+            _ops,
+            f"mixq_{world_size}_{lanes}",
+        )
+        ref = _run_mixed_ranks(
+            cpp_store, world_size, set(), _ops, f"refq_{world_size}_{lanes}"
+        )
+        for rank, (got, want) in enumerate(zip(mixed, ref)):
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"int8 allreduce diverged on rank {rank}"
+            )
+
+
+class TestTierDispatch:
+    def test_auto_prefers_cpp_for_flat_ring(self, monkeypatch) -> None:
+        from torchft_tpu import tier
+
+        monkeypatch.delenv("TORCHFT_TIER", raising=False)
+        monkeypatch.delenv("TORCHFT_HIERARCHICAL", raising=False)
+        assert tier.data_plane_tier() == "cpp"
+        comm = tier.make_communicator(timeout_s=5.0)
+        assert type(comm).__name__ == "CppCommunicator"
+        comm.shutdown()
+
+    def test_forced_hierarchical_downgrades_loudly(
+        self, monkeypatch, caplog
+    ) -> None:
+        from torchft_tpu import tier
+
+        monkeypatch.delenv("TORCHFT_TIER", raising=False)
+        monkeypatch.setenv("TORCHFT_HIERARCHICAL", "1")
+        with caplog.at_level("WARNING", logger="torchft_tpu.tier"):
+            assert tier.data_plane_tier() == "python"
+        assert any("downgraded" in r.message for r in caplog.records)
+        comm = tier.make_communicator(timeout_s=5.0)
+        assert type(comm).__name__ == "TCPCommunicator"
+        comm.shutdown()
+
+    def test_explicit_tier_env_is_honored(self, monkeypatch) -> None:
+        from torchft_tpu import tier
+
+        monkeypatch.setenv("TORCHFT_TIER", "python")
+        monkeypatch.delenv("TORCHFT_HIERARCHICAL", raising=False)
+        assert tier.data_plane_tier() == "python"
+        monkeypatch.setenv("TORCHFT_TIER", "cpp")
+        monkeypatch.setenv("TORCHFT_HIERARCHICAL", "1")
+        # explicit cpp wins even against forced hierarchy (warned)
+        assert tier.data_plane_tier() == "cpp"
+
+    def test_manager_defaults_to_tier_factory(self, monkeypatch) -> None:
+        """A Manager constructed without a comm rides the tier factory —
+        the train loop reaches the native mesh with zero caller wiring."""
+        from torchft_tpu.manager import Manager
+
+        monkeypatch.delenv("TORCHFT_TIER", raising=False)
+        monkeypatch.delenv("TORCHFT_HIERARCHICAL", raising=False)
+        lh = native.CppLighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=50,
+            quorum_tick_ms=20,
+        )
+        manager = None
+        try:
+            manager = Manager(
+                min_replica_size=1,
+                replica_id="tier_default_0",
+                lighthouse_addr=lh.local_address(),
+                timeout=10.0,
+                quorum_timeout=10.0,
+                use_async_quorum=False,
+                server_cls=native.CppManagerServer,
+            )
+            assert type(manager._comm).__name__ == "CppCommunicator"
+        finally:
+            if manager is not None:
+                manager.shutdown()
+            lh.shutdown()
+
+
+class TestZeroCopyHandoff:
+    def test_as_host_array_jax_dlpack_is_zero_copy(self) -> None:
+        import jax.numpy as jnp
+
+        a = jnp.arange(1024, dtype=jnp.float32)
+        view = native.as_host_array(a)
+        assert isinstance(view, np.ndarray)
+        # zero copy: the view aliases the jax CPU buffer
+        assert view.ctypes.data == np.asarray(a).ctypes.data
+        np.testing.assert_array_equal(view, np.arange(1024, dtype=np.float32))
+
+    def test_as_host_array_buffer_protocol(self) -> None:
+        raw = bytearray(b"\x01\x02\x03\x04")
+        view = native.as_host_array(raw)
+        assert view.dtype == np.uint8
+        view[0] = 9  # bytearray view is writable and aliases
+        assert raw[0] == 9
+
+    def test_multi_array_allreduce_no_concat(self, cpp_store) -> None:
+        """A list of arrays rides one ring as scattered iovec segments;
+        in_place results alias the caller's buffers (no staging copy)."""
+
+        def _fn(comm, rank):
+            bufs = [
+                np.full(1000, float(rank + 1), dtype=np.float32),
+                np.full((32, 33), float(10 * (rank + 1)), dtype=np.float32),
+                np.full(7, rank + 1, dtype=np.int32),
+            ]
+            out = comm.allreduce(bufs, ReduceOp.SUM, in_place=True).wait(
+                timeout=30.0
+            )
+            # f32 outputs alias the inputs (zero-copy in-place reduce)
+            assert out[0].base is bufs[0] or out[0] is bufs[0]
+            return [np.asarray(o) for o in out]
+
+        results = _run_ranks(cpp_store, 2, _fn)
+        for res in results:
+            np.testing.assert_allclose(res[0], np.full(1000, 3.0))
+            np.testing.assert_allclose(res[1], np.full((32, 33), 30.0))
+            np.testing.assert_array_equal(res[2], np.full(7, 3, np.int32))
+
+    def test_jax_array_allreduce(self, cpp_store) -> None:
+        """JAX CPU arrays hand off via dlpack (read-only view → one landing
+        copy, never a concatenation stage)."""
+        import jax.numpy as jnp
+
+        def _fn(comm, rank):
+            bufs = [
+                jnp.full(513, float(rank + 1), dtype=jnp.float32),
+                jnp.arange(100, dtype=jnp.float32) * (rank + 1),
+            ]
+            out = comm.allreduce(bufs, ReduceOp.SUM).wait(timeout=30.0)
+            return [np.asarray(o) for o in out]
+
+        results = _run_ranks(cpp_store, 2, _fn)
+        for res in results:
+            np.testing.assert_allclose(res[0], np.full(513, 3.0))
+            np.testing.assert_allclose(
+                res[1], np.arange(100, dtype=np.float32) * 3
+            )
+
+    def test_send_bytes_jax_source(self, cpp_store) -> None:
+        import jax.numpy as jnp
+
+        payload = jnp.arange(256, dtype=jnp.int32)
+
+        def _fn(comm, rank):
+            if rank == 0:
+                comm.send_bytes(payload, dst=1, tag=77).wait(timeout=30.0)
+                return None
+            out = np.empty(256, dtype=np.int32)
+            got = comm.recv_bytes_into(0, out, tag=77).wait(timeout=30.0)
+            assert got == out.nbytes
+            return out
+
+        results = _run_ranks(cpp_store, 2, _fn)
+        np.testing.assert_array_equal(
+            results[1], np.arange(256, dtype=np.int32)
+        )
+
+
+class TestNativeLaneStats:
+    def test_lane_stats_tier_agnostic_keys(
+        self, cpp_store, monkeypatch
+    ) -> None:
+        """The native counters expose the same core surface the Python
+        tier's lane_stats() does, so manager.last_quorum_timings and the
+        torchft_quorums extras are tier-agnostic."""
+        monkeypatch.setenv("TORCHFT_RING_LANES", "2")
+        monkeypatch.setenv("TORCHFT_RING_FRAME_KB", "64")
+        n = 200_000  # ~800KB → stripes across both lanes
+
+        def _fn(comm, rank):
+            data = np.ones(n, dtype=np.float32) * (rank + 1)
+            comm.allreduce(data, ReduceOp.SUM, in_place=True).wait(
+                timeout=30.0
+            )
+            return comm.lane_stats()
+
+        stats = _run_ranks(cpp_store, 2, _fn)[0]
+        # key parity with TCPCommunicator.lane_stats() (core counters)
+        for key in (
+            "lanes",
+            "stripe_floor_bytes",
+            "lane_tx_bytes",
+            "lane_rx_bytes",
+            "lane_stalls",
+            "lane_reconnects",
+            "lane_failovers",
+            "faults_injected",
+            "dead_lanes",
+        ):
+            assert key in stats, f"missing lane_stats key {key}"
+        assert stats["lanes"] == 2
+        assert len(stats["lane_tx_bytes"]) == 2
+        # the ring moved the payload: both lanes carried bytes
+        assert all(b > 0 for b in stats["lane_tx_bytes"])
+        assert all(b > 0 for b in stats["lane_rx_bytes"])
+
+    def test_unconfigured_lane_stats_empty(self) -> None:
+        comm = native.CppCommunicator(timeout_s=5.0)
+        assert comm.lane_stats() == {}
+        comm.shutdown()
+
+
+class TestNativePacerParity:
+    def test_auto_lane_and_floor_parity_under_emulation(
+        self, cpp_store, monkeypatch
+    ) -> None:
+        """Under TORCHFT_NET_EMU both tiers must derive the SAME auto lane
+        count and stripe floor (the rendezvous hello verifies them loudly),
+        and a mixed mesh must still produce bit-identical sums — the pacer
+        exists on both sides of the wire."""
+        monkeypatch.setenv("TORCHFT_NET_EMU", "dcn_10g")
+        n = 50_000
+
+        def _ops(comm, rank):
+            data = np.arange(n, dtype=np.float32) * (rank + 1)
+            out = comm.allreduce(data, ReduceOp.SUM).wait(timeout=60.0)
+            return np.asarray(out), comm.lane_stats()
+
+        mixed = _run_mixed_ranks(cpp_store, 2, {1}, _ops, "emu_mix")
+        expected = np.arange(n, dtype=np.float32) * 3
+        for out, _stats in mixed:
+            np.testing.assert_array_equal(out, expected)
+        py_stats, cpp_stats = mixed[0][1], mixed[1][1]
+        assert py_stats["lanes"] == cpp_stats["lanes"] == 4  # dcn_10g auto
+        assert (
+            py_stats["stripe_floor_bytes"] == cpp_stats["stripe_floor_bytes"]
+        )
+
+    def test_unknown_profile_is_loud(self, monkeypatch) -> None:
+        monkeypatch.setenv("TORCHFT_NET_EMU", "wan_9000g")
+        comm = native.CppCommunicator(timeout_s=5.0)
+        store = native.CppStoreServer("127.0.0.1:0")
+        try:
+            with pytest.raises(Exception, match="TORCHFT_NET_EMU"):
+                comm.configure(
+                    f"127.0.0.1:{store.port}/loud",
+                    replica_id="r0",
+                    rank=0,
+                    world_size=2,
+                )
+        finally:
+            comm.shutdown()
+            store.shutdown()
+
+
 def test_full_native_stack_kill_and_heal() -> None:
     """The whole FT protocol on the native runtime: C++ lighthouse, C++
     manager sidecars, C++ communicators — threads-as-replicas with a kill,

@@ -15,8 +15,19 @@ every shared constant against its live Python counterpart:
 - stripe alignment: ``lane_parts``'s ``/ 64 * 64`` cut and
   ``outer_shard_parts``'s ``unit % 64`` / ``unit = 64`` default
   == ``communicator._STRIPE_ALIGN``
-- default stripe floor (``stripe_floor_from_env``)
-  == ``communicator._MIN_STRIPE_BYTES``
+- ``kMinStripeBytes``       == ``communicator._MIN_STRIPE_BYTES``
+- ``kMaxAutoLanes``         == ``communicator._MAX_AUTO_LANES``
+- ``kRingReduceTagBase``    == ``wire.RING_REDUCE_TAG_BASE``
+- ``kMaxIovSegs``           == ``native._MAX_IOV_SEGS`` (the scatter-gather
+  framing's per-syscall segment batch, mirrored in the ctypes binding)
+- pacer knob names: ``comm.h`` must reference every ``TORCHFT_NET_*`` env
+  knob the Python ``_NetEmu`` reads (same pacing model on both tiers), and
+  its ``kNetEmuProfiles`` table must match ``communicator._NET_EMU_PROFILES``
+  name-for-name and value-for-value in both directions
+- per-lane counter names: ``comm.h`` must define the ``lane_tx_bytes`` /
+  ``lane_rx_bytes`` / ``lane_stalls`` counters and ``native.py`` must
+  export the same ``lane_stats()`` keys the Python tier does, so
+  ``manager.last_quorum_timings`` stays tier-agnostic
 - the ``outer_shard_parts`` padding formula matches the canonical
   ceil-to-unit form, and mirrored symbols (``HostTopology`` with its
   ``worth_it`` auto criterion, ``lane_parts``, ``outer_shard_parts``)
@@ -35,6 +46,26 @@ CHECKER = "native-mirror"
 
 _COMM_H = os.path.join("native", "comm.h")
 _WIRE_H = os.path.join("native", "wire.h")
+_BINDING = os.path.join("torchft_tpu", "native.py")
+
+# the env knobs the Python _NetEmu pacer reads; the native pacer must read
+# the same set or cross-tier benches shape only one side of the wire
+_PACER_KNOBS = (
+    "TORCHFT_NET_EMU",
+    "TORCHFT_NET_GBPS",
+    "TORCHFT_NET_RTT_MS",
+    "TORCHFT_NET_CWND_KB",
+)
+
+# the tier-agnostic lane_stats() core keys (TCPCommunicator.lane_stats);
+# the native binding must export the same names
+_LANE_STAT_KEYS = (
+    "lanes",
+    "stripe_floor_bytes",
+    "lane_tx_bytes",
+    "lane_rx_bytes",
+    "lane_stalls",
+)
 
 
 def _finding(rel: str, line: int, symbol: str, message: str) -> Finding:
@@ -194,20 +225,192 @@ def check_comm_header(text: str, rel: str = _COMM_H) -> List[Finding]:
             )
         )
 
-    # default stripe floor
-    m = re.search(
-        r'== "auto"\)\s*return\s+size_t\((\d+)\)\s*<<\s*(\d+);', text
-    )
+    # default stripe floor (kMinStripeBytes) + auto-lane cap (kMaxAutoLanes)
+    m = re.search(r"kMinStripeBytes\s*=\s*size_t\((\d+)\)\s*<<\s*(\d+)", text)
     if m:
         native_floor = int(m.group(1)) << int(m.group(2))
         if native_floor != pycomm._MIN_STRIPE_BYTES:
             findings.append(
                 _finding(
                     rel,
-                    _line_of(text, r"stripe_floor_from_env"),
-                    "stripe_floor",
-                    f"native default stripe floor = {native_floor} but "
-                    f"Python _MIN_STRIPE_BYTES = {pycomm._MIN_STRIPE_BYTES}",
+                    _line_of(text, r"kMinStripeBytes"),
+                    "kMinStripeBytes",
+                    f"native kMinStripeBytes = {native_floor} but Python "
+                    f"_MIN_STRIPE_BYTES = {pycomm._MIN_STRIPE_BYTES}",
+                )
+            )
+    m = re.search(r"kMaxAutoLanes\s*=\s*(\d+)", text)
+    if m and int(m.group(1)) != pycomm._MAX_AUTO_LANES:
+        findings.append(
+            _finding(
+                rel,
+                _line_of(text, r"kMaxAutoLanes"),
+                "kMaxAutoLanes",
+                f"native kMaxAutoLanes = {m.group(1)} but Python "
+                f"_MAX_AUTO_LANES = {pycomm._MAX_AUTO_LANES}",
+            )
+        )
+
+    # explicit reduce_scatter tag window — a drift here frames the ring at
+    # the wrong tags against a Python peer (silent cross-tier corruption)
+    m = re.search(r"kRingReduceTagBase\s*=\s*(\d+)", text)
+    from torchft_tpu import wire as pywire
+
+    if not m:
+        findings.append(
+            _finding(
+                rel,
+                1,
+                "kRingReduceTagBase",
+                "kRingReduceTagBase not found in comm.h — the native "
+                "reduce_scatter no longer mirrors wire.RING_REDUCE_TAG_BASE",
+            )
+        )
+    elif int(m.group(1)) != pywire.RING_REDUCE_TAG_BASE:
+        findings.append(
+            _finding(
+                rel,
+                _line_of(text, r"kRingReduceTagBase"),
+                "kRingReduceTagBase",
+                f"native kRingReduceTagBase = {m.group(1)} but Python "
+                f"wire.RING_REDUCE_TAG_BASE = {pywire.RING_REDUCE_TAG_BASE}",
+            )
+        )
+
+    # iovec segment batch: mirrored in the ctypes binding (_MAX_IOV_SEGS)
+    from torchft_tpu import native as pynative
+
+    m = re.search(r"kMaxIovSegs\s*=\s*(\d+)", text)
+    if not m:
+        findings.append(
+            _finding(
+                rel,
+                1,
+                "kMaxIovSegs",
+                "kMaxIovSegs not found in comm.h — the scatter-gather "
+                "framing cap is no longer mirrored",
+            )
+        )
+    elif int(m.group(1)) != pynative._MAX_IOV_SEGS:
+        findings.append(
+            _finding(
+                rel,
+                _line_of(text, r"kMaxIovSegs"),
+                "kMaxIovSegs",
+                f"native kMaxIovSegs = {m.group(1)} but native.py "
+                f"_MAX_IOV_SEGS = {pynative._MAX_IOV_SEGS}",
+            )
+        )
+
+    # pacer knob names: the native Pacer must read the same env surface
+    for knob in _PACER_KNOBS:
+        if knob not in text:
+            findings.append(
+                _finding(
+                    rel,
+                    1,
+                    f"pacer.{knob}",
+                    f"native pacer does not reference {knob} — the Python "
+                    "_NetEmu reads it, so cross-tier benches would shape "
+                    "only one side of the wire",
+                )
+            )
+
+    # pacer profile table: names and (gbps, rtt_ms) values both directions
+    native_profiles = {
+        name: (float(g), float(r))
+        for name, g, r in re.findall(
+            r'\{"(\w+)",\s*([\d.]+),\s*([\d.]+)\}', text
+        )
+    }
+    py_profiles = {
+        name: (float(g), float(r))
+        for name, (g, r) in pycomm._NET_EMU_PROFILES.items()
+    }
+    if native_profiles:
+        for name, vals in py_profiles.items():
+            if name not in native_profiles:
+                findings.append(
+                    _finding(
+                        rel,
+                        _line_of(text, r"kNetEmuProfiles"),
+                        f"pacer.profile.{name}",
+                        f"Python _NET_EMU_PROFILES has {name!r} but the "
+                        "native kNetEmuProfiles table does not",
+                    )
+                )
+            elif native_profiles[name] != vals:
+                findings.append(
+                    _finding(
+                        rel,
+                        _line_of(text, re.escape(name)),
+                        f"pacer.profile.{name}",
+                        f"native profile {name} = {native_profiles[name]} "
+                        f"but Python = {vals}",
+                    )
+                )
+        for name in native_profiles:
+            if name not in py_profiles:
+                findings.append(
+                    _finding(
+                        rel,
+                        _line_of(text, re.escape(name)),
+                        f"pacer.profile.{name}",
+                        f"native kNetEmuProfiles has {name!r} but Python "
+                        "_NET_EMU_PROFILES does not",
+                    )
+                )
+    elif "kNetEmuProfiles" not in text:
+        findings.append(
+            _finding(
+                rel,
+                1,
+                "kNetEmuProfiles",
+                "kNetEmuProfiles table not found in comm.h — the native "
+                "pacer no longer mirrors the Python profile set",
+            )
+        )
+
+    # per-lane counters: the members feeding the tier-agnostic lane_stats
+    for counter in ("lane_tx_bytes", "lane_rx_bytes", "lane_stalls"):
+        if counter not in text:
+            findings.append(
+                _finding(
+                    rel,
+                    1,
+                    f"counter.{counter}",
+                    f"native comm.h defines no {counter} counter — the "
+                    "tier-agnostic lane_stats surface is broken",
+                )
+            )
+    return findings
+
+
+def check_binding(text: str, rel: str = _BINDING) -> List[Finding]:
+    """The ctypes binding's mirrored surface: lane_stats key parity with
+    the Python tier and the iovec batch constant's presence."""
+    findings: List[Finding] = []
+    if not re.search(r"_MAX_IOV_SEGS\s*=\s*\d+", text):
+        findings.append(
+            _finding(
+                rel,
+                1,
+                "_MAX_IOV_SEGS",
+                "_MAX_IOV_SEGS not found in native.py — the scatter-gather "
+                "segment batch is no longer mirrored against comm.h",
+            )
+        )
+    for key in _LANE_STAT_KEYS:
+        if f'"{key}"' not in text:
+            findings.append(
+                _finding(
+                    rel,
+                    _line_of(text, r"def lane_stats"),
+                    f"lane_stats.{key}",
+                    f"native.py lane_stats() does not export {key!r} — "
+                    "TCPCommunicator.lane_stats() does, so "
+                    "manager.last_quorum_timings would lose it on the "
+                    "native tier",
                 )
             )
     return findings
@@ -215,7 +418,11 @@ def check_comm_header(text: str, rel: str = _COMM_H) -> List[Finding]:
 
 def check(root: str) -> List[Finding]:
     findings: List[Finding] = []
-    for rel, fn in ((_WIRE_H, check_wire_header), (_COMM_H, check_comm_header)):
+    for rel, fn in (
+        (_WIRE_H, check_wire_header),
+        (_COMM_H, check_comm_header),
+        (_BINDING, check_binding),
+    ):
         path = os.path.join(root, rel)
         if not os.path.exists(path):
             findings.append(

@@ -112,10 +112,10 @@ class Manager:
 
     def __init__(
         self,
-        comm: Communicator,
-        load_state_dict: Optional[Callable[[T], None]],
-        state_dict: Optional[Callable[[], T]],
-        min_replica_size: int,
+        comm: Optional[Communicator] = None,
+        load_state_dict: Optional[Callable[[T], None]] = None,
+        state_dict: Optional[Callable[[], T]] = None,
+        min_replica_size: int = 1,
         use_async_quorum: bool = True,
         timeout: float = 60.0,
         quorum_timeout: float = 60.0,
@@ -151,6 +151,16 @@ class Manager:
         if load_state_dict and state_dict:
             self.register_state_dict_fn("default", load_state_dict, state_dict)
 
+        self._timeout = _env_timeout(TIMEOUT_SEC_ENV, timeout)
+        if comm is None:
+            # tier-dispatched default: the native (cpp) mesh whenever the
+            # library loads and the topology permits, else the Python tier
+            # — so the train loop, DiLoCo outer sync, and heal drain all
+            # ride the production data plane without every caller wiring
+            # tier.make_communicator themselves
+            from torchft_tpu import tier as tier_mod
+
+            comm = tier_mod.make_communicator(timeout_s=self._timeout)
         self._comm = comm
         self._min_replica_size = min_replica_size
         self._use_async_quorum = use_async_quorum
@@ -158,7 +168,6 @@ class Manager:
         self._max_retries = max_retries
         self._replica_world_size_mode = world_size_mode
 
-        self._timeout = _env_timeout(TIMEOUT_SEC_ENV, timeout)
         self._quorum_timeout = _env_timeout(QUORUM_TIMEOUT_SEC_ENV, quorum_timeout)
         self._connect_timeout = _env_timeout(CONNECT_TIMEOUT_SEC_ENV, connect_timeout)
         quorum_retries = knobs.get_int(QUORUM_RETRIES_ENV, quorum_retries)

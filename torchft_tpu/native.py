@@ -21,10 +21,11 @@ import queue
 import subprocess
 import threading
 from concurrent.futures import Future
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from torchft_tpu import knobs
 from torchft_tpu.communicator import (
     Buffers,
     Communicator,
@@ -37,15 +38,26 @@ from torchft_tpu.work import Work
 
 logger = logging.getLogger(__name__)
 
-# Native sources live beside the repo checkout; for installed wheels (where
-# no sibling native/ exists) point TORCHFT_NATIVE_DIR at a sources/lib dir.
-_NATIVE_DIR = os.environ.get(
-    "TORCHFT_NATIVE_DIR",
-    os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
-    ),
-)
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libtpuft.so")
+# Mirror of native/comm.h kMaxIovSegs — the max payload iovec segments the
+# NATIVE side packs into one sendmsg/recvmsg syscall (the binding itself
+# passes arbitrarily many buffers; batching happens in C).  Declared here
+# so the ftlint native-mirror checker pins the two sides together.
+_MAX_IOV_SEGS = 64
+
+
+def _native_dir() -> str:
+    """Directory holding the native build.  Native sources live beside the
+    repo checkout; for installed wheels (where no sibling native/ exists)
+    point TORCHFT_NATIVE_DIR at a sources/lib dir.  Read through the typed
+    knob accessor at call time so monkeypatched tests behave like every
+    other knob."""
+    return knobs.get_str(
+        "TORCHFT_NATIVE_DIR",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "native",
+        ),
+    )
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_error: Optional[str] = None
@@ -63,19 +75,19 @@ _DTYPE_CODES = {
 _OP_CODES = {ReduceOp.SUM: 0, ReduceOp.AVG: 0, ReduceOp.MAX: 1, ReduceOp.MIN: 2}
 
 
-def _build_lib() -> None:
+def _build_lib(native_dir: str, lib_path: str) -> None:
     sources = [
-        os.path.join(_NATIVE_DIR, f)
-        for f in os.listdir(_NATIVE_DIR)
+        os.path.join(native_dir, f)
+        for f in os.listdir(native_dir)
         if f.endswith((".cc", ".h"))
     ]
-    if os.path.exists(_LIB_PATH):
-        lib_mtime = os.path.getmtime(_LIB_PATH)
+    if os.path.exists(lib_path):
+        lib_mtime = os.path.getmtime(lib_path)
         if all(os.path.getmtime(s) <= lib_mtime for s in sources):
             return
-    logger.info("building native runtime (make -C %s)", _NATIVE_DIR)
+    logger.info("building native runtime (make -C %s)", native_dir)
     subprocess.run(
-        ["make", "-C", _NATIVE_DIR],
+        ["make", "-C", native_dir],
         check=True,
         capture_output=True,
         timeout=300,
@@ -88,8 +100,10 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_error is not None:
             return _lib
         try:
-            _build_lib()
-            lib = ctypes.CDLL(_LIB_PATH)
+            native_dir = _native_dir()
+            lib_path = os.path.join(native_dir, "libtpuft.so")
+            _build_lib(native_dir, lib_path)
+            lib = ctypes.CDLL(lib_path)
         except Exception as e:  # noqa: BLE001
             _lib_error = str(e)
             logger.warning("native runtime unavailable: %s", e)
@@ -138,6 +152,30 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_uint64,
             ctypes.c_int32,
             ctypes.c_int32,
+        ]
+        lib.tpuft_comm_allreduce_iov.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+        ]
+        lib.tpuft_comm_alltoall_ptrs.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+        ]
+        lib.tpuft_comm_lane_stats.restype = ctypes.c_uint64
+        lib.tpuft_comm_lane_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.tpuft_comm_reduce_scatter.argtypes = [
             ctypes.c_void_p,
@@ -318,19 +356,42 @@ def _data_ptr(arr: np.ndarray) -> ctypes.c_void_p:
     return arr.ctypes.data_as(ctypes.c_void_p)
 
 
-def _buffer_ptr(data) -> Tuple[ctypes.c_void_p, int, object]:
-    """(pointer, nbytes, keepalive) into any contiguous buffer-protocol
-    object with NO copy — the round-1 send path built intermediate ``bytes``
-    objects, a full-payload copy per hop.  ``keepalive`` is the object that
-    actually backs the pointer; the caller must pin it until the op is done
-    (it is ``data`` itself unless a contiguity copy was required)."""
+def as_host_array(data) -> np.ndarray:
+    """Zero-copy numpy view of any host buffer: numpy arrays pass through,
+    buffer-protocol objects (bytes, bytearray, memoryview) come back as
+    uint8 views, and dlpack-capable sources — JAX CPU arrays included —
+    come back via ``np.from_dlpack`` (read-only, aliasing the producer's
+    buffer).  Only objects that support none of those are copied
+    (``np.asarray`` fallback).  The native data plane reads frames straight
+    out of (and, for writable views, lands receives straight into) the
+    returned array's memory — no staging copy."""
     if isinstance(data, np.ndarray):
-        arr = data if data.flags.c_contiguous else np.ascontiguousarray(data)
-        return ctypes.c_void_p(arr.ctypes.data), int(arr.nbytes), arr
-    # bytes / bytearray / memoryview / anything buffer-protocol:
-    # np.frombuffer is a zero-copy view into the object's buffer
-    view = np.frombuffer(memoryview(data).cast("B"), dtype=np.uint8)
-    return ctypes.c_void_p(view.ctypes.data), view.size, view
+        return data
+    if hasattr(data, "__dlpack__"):
+        # dlpack first for array-likes (jax CPU arrays): preserves
+        # dtype/shape where the raw buffer protocol would flatten to bytes
+        try:
+            return np.from_dlpack(data)
+        except (TypeError, AttributeError, RuntimeError, BufferError):
+            pass
+    try:
+        # buffer protocol: bytes-like objects keep their exact bytes
+        return np.frombuffer(memoryview(data).cast("B"), dtype=np.uint8)
+    except TypeError:
+        return np.asarray(data)
+
+
+def _buffer_ptr(data) -> Tuple[ctypes.c_void_p, int, object]:
+    """(pointer, nbytes, keepalive) into any contiguous buffer-protocol or
+    dlpack-capable object with NO copy — the round-1 send path built
+    intermediate ``bytes`` objects, a full-payload copy per hop.
+    ``keepalive`` is the object that actually backs the pointer; the caller
+    must pin it until the op is done (it is ``data`` itself unless a
+    contiguity copy was required)."""
+    arr = as_host_array(data)
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    return _data_ptr(arr), int(arr.nbytes), (arr, data)
 
 
 def _last_error(lib: ctypes.CDLL) -> str:
@@ -499,6 +560,12 @@ class CppCommunicator(Communicator):
         self._epoch = 0
         self._ops: "queue.Queue[Optional[Tuple[Callable[[], object], Future]]]" = queue.Queue()
         self._op_thread: Optional[threading.Thread] = None
+        # ops currently EXECUTING (the queue no longer holds them) — the
+        # busy() probe's other half; own lock because overlapping old/new
+        # epoch op threads can race the += / -= pair (same doctrine as
+        # TCPCommunicator._inflight_ops)
+        self._inflight_ops = 0
+        self._inflight_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -618,6 +685,53 @@ class CppCommunicator(Communicator):
     def set_timeout(self, timeout_s: float) -> None:
         self._timeout_s = timeout_s
 
+    def busy(self) -> bool:
+        """True while an op is executing or queued in the current epoch —
+        the idle-priority yield probe (see TCPCommunicator.busy).  The
+        queue alone is not enough: ``_run_ops`` dequeues BEFORE running,
+        so a multi-second in-flight collective leaves the queue empty."""
+        if self._inflight_ops > 0:
+            return True
+        ops = self._ops
+        return ops is not None and not ops.empty()
+
+    def lane_stats(self) -> Dict[str, object]:
+        """Per-lane observability of the current epoch, tier-agnostic with
+        :meth:`TCPCommunicator.lane_stats`: lane count, stripe floor,
+        payload bytes sent/received per lane, and stall events (pacer
+        denials / kernel would-block).  The gray-failure counters the
+        Python tier additionally exports (reconnects/failovers/injected
+        faults) report 0 — the native tier has no fault injection or
+        in-epoch lane recovery yet.  Empty when unconfigured or
+        single-member."""
+        with self._lock:
+            if self._h is None or self._world_size <= 1:
+                return {}
+            cap = 64
+            tx = (ctypes.c_uint64 * cap)()
+            rx = (ctypes.c_uint64 * cap)()
+            stalls = (ctypes.c_uint64 * cap)()
+            floor = ctypes.c_uint64()
+            lanes = int(
+                self._lib.tpuft_comm_lane_stats(
+                    self._h, tx, rx, stalls, cap, ctypes.byref(floor)
+                )
+            )
+        if lanes <= 0:
+            return {}
+        n = min(lanes, cap)
+        return {
+            "lanes": lanes,
+            "stripe_floor_bytes": int(floor.value),
+            "lane_tx_bytes": [int(tx[i]) for i in range(n)],
+            "lane_rx_bytes": [int(rx[i]) for i in range(n)],
+            "lane_stalls": [int(stalls[i]) for i in range(n)],
+            "lane_reconnects": 0,
+            "lane_failovers": 0,
+            "faults_injected": 0,
+            "dead_lanes": 0,
+        }
+
     # -- op machinery ------------------------------------------------------
 
     def _run_ops(self, ops: "queue.Queue", epoch: int) -> None:
@@ -635,6 +749,8 @@ class CppCommunicator(Communicator):
                     epoch, f"op timed out after {timeout_s}s"
                 ),
             )
+            with self._inflight_lock:
+                self._inflight_ops += 1
             try:
                 result = fn()
             except BaseException as e:  # noqa: BLE001
@@ -647,6 +763,8 @@ class CppCommunicator(Communicator):
             else:
                 fut.set_result(result)
             finally:
+                with self._inflight_lock:
+                    self._inflight_ops -= 1
                 handle.cancel()
 
     def _submit(self, fn: Callable[[], object]) -> Work:
@@ -671,9 +789,12 @@ class CppCommunicator(Communicator):
 
     @staticmethod
     def _as_list(buffers: Buffers) -> List[np.ndarray]:
+        """Host views of the input buffers — numpy passes through, dlpack /
+        buffer-protocol sources (JAX CPU arrays included) come back as
+        zero-copy views (:func:`as_host_array`)."""
         if isinstance(buffers, np.ndarray):
             return [buffers]
-        return [np.asarray(b) for b in buffers]
+        return [as_host_array(b) for b in buffers]
 
     def allreduce(
         self,
@@ -687,44 +808,59 @@ class CppCommunicator(Communicator):
 
         def _run() -> object:
             out: List[np.ndarray] = [None] * len(arrays)  # type: ignore[list-item]
-            # one contiguous native buffer per dtype
-            by_dtype = {}
+            # one native call per dtype (each dtype needs its own reduce
+            # loop); the arrays of a group ride ONE ring as scattered iovec
+            # segments — the round-1 binding np.concatenate'd them into a
+            # staging buffer and sliced the result back out, a full extra
+            # payload copy each way
+            by_dtype: Dict[str, List[int]] = {}
             for i, a in enumerate(arrays):
                 by_dtype.setdefault(a.dtype.name, []).append(i)
             for dtype_name, idxs in by_dtype.items():
                 code = _DTYPE_CODES.get(dtype_name)
                 if code is None:
                     raise CommunicatorError(f"unsupported dtype {dtype_name}")
-                if len(idxs) == 1:
-                    a = arrays[idxs[0]]
-                    if in_place and a.flags.c_contiguous and a.flags.writeable:
+                flats: List[np.ndarray] = []
+                for i in idxs:
+                    a = arrays[i]
+                    if (
+                        in_place
+                        and a.flags.c_contiguous
+                        and a.flags.writeable
+                    ):
                         # zero-copy: the native ring reduces straight into
                         # the caller's buffer (returned aliased)
                         flat = a.reshape(-1)
                     else:
-                        # the native op is in-place; copy once to preserve
-                        # the caller's buffer
+                        # the native op is in-place; copy this one array to
+                        # preserve the caller's buffer (also the landing
+                        # spot for read-only dlpack views)
                         flat = np.array(a, copy=True).reshape(-1)
-                else:
-                    flat = np.concatenate(
-                        [np.ascontiguousarray(arrays[i]).reshape(-1) for i in idxs]
+                    flats.append(flat)
+                    out[i] = flat
+                total = sum(int(f.nbytes) for f in flats)
+                if total > 0:
+                    n = len(flats)
+                    ptrs = (ctypes.c_void_p * n)(
+                        *(_data_ptr(f) for f in flats)
                     )
-                self._check(
-                    self._lib.tpuft_comm_allreduce(
-                        self._h, _data_ptr(flat), flat.nbytes, code, _OP_CODES[op]
-                    ),
-                    "allreduce",
-                )
+                    lens = (ctypes.c_uint64 * n)(
+                        *(int(f.nbytes) for f in flats)
+                    )
+                    self._check(
+                        self._lib.tpuft_comm_allreduce_iov(
+                            self._h, ptrs, lens, n, code, _OP_CODES[op]
+                        ),
+                        "allreduce",
+                    )
                 if op == ReduceOp.AVG:
-                    if np.issubdtype(flat.dtype, np.integer):
-                        flat //= ws
-                    else:
-                        np.divide(flat, ws, out=flat)
-                off = 0
+                    for f in flats:
+                        if np.issubdtype(f.dtype, np.integer):
+                            f //= ws
+                        else:
+                            np.divide(f, ws, out=f)
                 for i in idxs:
-                    n = arrays[i].size
-                    out[i] = flat[off : off + n].reshape(arrays[i].shape)
-                    off += n
+                    out[i] = out[i].reshape(arrays[i].shape)
             return out[0] if single else out
 
         return self._submit(_run)
@@ -847,7 +983,9 @@ class CppCommunicator(Communicator):
         return self._submit(_run)
 
     def alltoall(self, chunks: List[np.ndarray], tag: int = 0) -> Work:
-        arrays = [np.ascontiguousarray(c) for c in chunks]
+        arrays = [
+            np.ascontiguousarray(as_host_array(c)) for c in chunks
+        ]
 
         def _run() -> object:
             ws = self._world_size
@@ -858,12 +996,16 @@ class CppCommunicator(Communicator):
             assert all(a.nbytes == chunk_bytes for a in arrays), (
                 "cpp alltoall requires equal-size chunks"
             )
-            packed = np.concatenate([a.reshape(-1).view(np.uint8) for a in arrays])
+            # one pointer per destination chunk: frames leave straight from
+            # the callers' buffers (the round-1 binding packed them into a
+            # staging concatenation first); receives land in one buffer
+            # handed back as per-source views
+            ptrs = (ctypes.c_void_p * ws)(*(_data_ptr(a) for a in arrays))
             out = np.empty(ws * chunk_bytes, dtype=np.uint8)
             self._check(
-                self._lib.tpuft_comm_alltoall(
+                self._lib.tpuft_comm_alltoall_ptrs(
                     self._h,
-                    packed.ctypes.data_as(ctypes.c_void_p),
+                    ptrs,
                     out.ctypes.data_as(ctypes.c_void_p),
                     chunk_bytes,
                     tag,
