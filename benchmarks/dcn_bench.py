@@ -426,7 +426,11 @@ def _hier_host_main(proc_idx, hosts, per_host, port, mb, iters, gbps, rtt_ms, ou
         with ThreadPoolExecutor(max_workers=per_host) as pool:
             got = list(
                 pool.map(
-                    lambda r: _one_rank(r, mode, f"hier_{label}_{per_host}"),
+                    # bind mode/label now: the lambda must not close
+                    # over the live loop variables (ruff B023)
+                    lambda r, mode=mode, label=label: _one_rank(
+                        r, mode, f"hier_{label}_{per_host}"
+                    ),
                     local_ranks,
                 )
             )

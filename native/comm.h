@@ -556,7 +556,11 @@ class LanePool {
   ~LanePool() { shutdown(); }
 
   void submit(int64_t peer, size_t lane, int dir, std::function<void()> fn) {
-    Worker* w = nullptr;
+    // shared_ptr, not a raw pointer: shutdown() (a foreign thread's
+    // configure() superseding this epoch) may join AND DESTROY the worker
+    // between our mu_ release and the w->mu acquire below — the copy keeps
+    // the Worker alive until this submit is done with it
+    std::shared_ptr<Worker> w;
     {
       std::lock_guard<std::mutex> lock(mu_);
       if (!stopped_) {
@@ -565,11 +569,11 @@ class LanePool {
                        static_cast<uint64_t>(dir & 1);
         auto it = workers_.find(key);
         if (it == workers_.end()) {
-          it = workers_.emplace(key, std::make_unique<Worker>()).first;
+          it = workers_.emplace(key, std::make_shared<Worker>()).first;
           Worker* raw = it->second.get();
           raw->th = std::thread([raw] { raw->run(); });
         }
-        w = it->second.get();
+        w = it->second;
       }
     }
     if (w == nullptr) {
@@ -580,13 +584,22 @@ class LanePool {
     }
     {
       std::lock_guard<std::mutex> lock(w->mu);
-      w->q.push_back(std::move(fn));
+      if (!w->stop) {
+        w->q.push_back(std::move(fn));
+        w->cv.notify_one();
+        return;
+      }
+      // shutdown() won the race between our stopped_ check and this
+      // enqueue: the worker may already have drained and exited, so a
+      // task pushed now would sit in the queue forever and its latch
+      // would never release — run inline instead (fails fast like the
+      // pool-stopped path above)
     }
-    w->cv.notify_one();
+    fn();
   }
 
   void shutdown() {
-    std::map<uint64_t, std::unique_ptr<Worker>> workers;
+    std::map<uint64_t, std::shared_ptr<Worker>> workers;
     {
       std::lock_guard<std::mutex> lock(mu_);
       if (stopped_) return;
@@ -628,7 +641,7 @@ class LanePool {
 
   std::mutex mu_;
   bool stopped_ = false;
-  std::map<uint64_t, std::unique_ptr<Worker>> workers_;
+  std::map<uint64_t, std::shared_ptr<Worker>> workers_;
 };
 
 // completion latch for a fan-out of lane tasks; collects the first error
@@ -742,6 +755,13 @@ struct EpochIO {
   std::unique_ptr<Pacer> pacer;
   size_t lanes = 1;
   size_t stripe_floor = kMinStripeBytes;
+  // the epoch's identity rides the snapshot too: an op body that read
+  // rank_/world_size_ more than once could see configure() move them
+  // between loads (size a vector from the old world, index it with the
+  // new one — an out-of-bounds write, not just a stale value).  One
+  // io_snapshot() at op entry yields all-or-nothing epoch state.
+  int64_t rank = 0;
+  int64_t world = 1;
   // per-lane observability: payload bytes moved and stall events (pacer
   // denials / kernel would-block), names mirroring _TcpMesh lane_tx_bytes
   // / lane_rx_bytes / lane_stalls
@@ -833,24 +853,39 @@ class Communicator {
     // join the superseded epoch's lane workers: their sockets are shut
     // down, so any in-flight task errors out within one IO quantum
     if (old_pool) old_pool->shutdown();
-    aborted_ = false;
     // fresh per-epoch IO state; a superseded op thread keeps the OLD
-    // instance alive through its own shared_ptr snapshot
+    // instance alive through its own shared_ptr snapshot.  NOTHING is
+    // published until the rendezvous is complete: ops racing configure()
+    // keep failing fast on the latched abort + the old (cleared) peers
+    // instead of seeing a half-built epoch (e.g. the new rank with the
+    // old caller's buffer sizes), and abort is un-latched only after the
+    // whole epoch — io, pool, peers — lands in one lock section.
     auto io = std::make_shared<EpochIO>();
     io->pacer = Pacer::from_env();
     io->lanes = ring_lanes_from_env(io->pacer.get());
     io->stripe_floor = stripe_floor_from_env(io->pacer.get());
+    io->rank = rank;
+    io->world = world_size;
     io->alloc_counters();
-    lanes_ = io->lanes;
-    stripe_floor_ = io->stripe_floor;
-    rank_ = rank;
-    world_size_ = world_size;
-    {
-      std::lock_guard<std::mutex> lock(state_mu_);
-      io_ = std::move(io);
-      pool_ = std::make_shared<LanePool>();
+    const size_t lanes = io->lanes;
+    const size_t stripe_floor = io->stripe_floor;
+    auto publish = [&](std::map<int64_t, std::vector<int>> peers) {
+      {
+        std::lock_guard<std::mutex> lock(state_mu_);
+        io_ = std::move(io);
+        pool_ = std::make_shared<LanePool>();
+        peers_ = std::move(peers);
+      }
+      lanes_ = lanes;
+      stripe_floor_ = stripe_floor;
+      rank_ = rank;
+      world_size_ = world_size;
+      aborted_ = false;
+    };
+    if (world_size <= 1) {
+      publish({});
+      return;
     }
-    if (world_size <= 1) return;
 
     auto slash = store_prefixed_addr.find('/');
     std::string store_addr = store_prefixed_addr.substr(0, slash);
@@ -873,12 +908,12 @@ class Communicator {
         host_str = "127.0.0.1";
       if (res) ::freeaddrinfo(res);
     }
-    store.set(prefix + "/" + std::to_string(rank_),
+    store.set(prefix + "/" + std::to_string(rank),
               host_str + ":" + std::to_string(port));
 
     // accept from higher ranks on a helper thread while dialing lower ranks
     int expected_inbound =
-        static_cast<int>((world_size - rank - 1) * lanes_);
+        static_cast<int>((world_size - rank - 1) * lanes);
     std::map<int64_t, std::vector<int>> inbound;
     std::string accept_err;
     // bound the whole accept phase: a dead higher-rank peer must not wedge
@@ -897,10 +932,10 @@ class Communicator {
           if (!(first & kLaneHelloFlag)) {
             // legacy 8-byte hello: a single-lane peer.  A lane mismatch is
             // a config error — fail LOUDLY instead of desynchronizing.
-            if (lanes_ != 1)
+            if (lanes != 1)
               throw CommError(
                   "lane-count mismatch: rank " + std::to_string(first) +
-                  " has 1 lane, we have " + std::to_string(lanes_) +
+                  " has 1 lane, we have " + std::to_string(lanes) +
                   " (TORCHFT_RING_LANES must be uniform)");
             auto& fds = inbound[static_cast<int64_t>(first)];
             fds.assign(1, conn);
@@ -908,25 +943,25 @@ class Communicator {
             uint64_t tail[3];  // lane, lane count, stripe floor
             recv_exact(conn, tail, 24);
             uint64_t peer_rank = first & ~kLaneHelloFlag;
-            if (tail[1] != lanes_)
+            if (tail[1] != lanes)
               throw CommError(
                   "lane-count mismatch: rank " + std::to_string(peer_rank) +
                   " has " + std::to_string(tail[1]) + " lanes, we have " +
-                  std::to_string(lanes_) +
+                  std::to_string(lanes) +
                   " (TORCHFT_RING_LANES must be uniform)");
-            if (tail[2] != stripe_floor_)
+            if (tail[2] != stripe_floor)
               throw CommError(
                   "stripe-floor mismatch: rank " + std::to_string(peer_rank) +
                   " has " + std::to_string(tail[2]) + " bytes, we have " +
-                  std::to_string(stripe_floor_) +
+                  std::to_string(stripe_floor) +
                   " (TORCHFT_RING_FRAME_KB must be uniform)");
-            if (tail[0] >= lanes_)
+            if (tail[0] >= lanes)
               throw CommError(
                   "lane index out of range in hello from rank " +
                   std::to_string(peer_rank) + ": lane " +
-                  std::to_string(tail[0]) + " >= " + std::to_string(lanes_));
+                  std::to_string(tail[0]) + " >= " + std::to_string(lanes));
             auto& fds = inbound[static_cast<int64_t>(peer_rank)];
-            if (fds.size() < lanes_) fds.resize(lanes_, -1);
+            if (fds.size() < lanes) fds.resize(lanes, -1);
             fds[tail[0]] = conn;
           }
         }
@@ -937,18 +972,18 @@ class Communicator {
 
     std::map<int64_t, std::vector<int>> fresh;
     try {
-      for (int64_t peer = 0; peer < rank_; ++peer) {
+      for (int64_t peer = 0; peer < rank; ++peer) {
         std::string addr =
             store.get(prefix + "/" + std::to_string(peer), timeout_s_);
         auto& fds = fresh[peer];
-        for (size_t lane = 0; lane < lanes_; ++lane) {
+        for (size_t lane = 0; lane < lanes; ++lane) {
           int fd = dial(addr, timeout_s_);
-          if (lanes_ == 1) {
-            uint64_t my_rank = static_cast<uint64_t>(rank_);
+          if (lanes == 1) {
+            uint64_t my_rank = static_cast<uint64_t>(rank);
             send_all(fd, &my_rank, 8);
           } else {
-            uint64_t hello[4] = {static_cast<uint64_t>(rank_) | kLaneHelloFlag,
-                                 lane, lanes_, stripe_floor_};
+            uint64_t hello[4] = {static_cast<uint64_t>(rank) | kLaneHelloFlag,
+                                 lane, lanes, stripe_floor};
             send_all(fd, hello, 32);
           }
           fds.push_back(fd);
@@ -981,10 +1016,7 @@ class Communicator {
         ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
       }
     }
-    {
-      std::lock_guard<std::mutex> lock(state_mu_);
-      peers_ = std::move(fresh);
-    }
+    publish(std::move(fresh));
   }
 
   void abort() {
@@ -1015,8 +1047,9 @@ class Communicator {
   // tier (_lane_parts): both endpoints derive the split from the frame
   // length alone, 64-byte aligned so no element ever straddles lanes
   std::vector<std::pair<size_t, size_t>> lane_parts(size_t nbytes) const {
-    if (lanes_ <= 1 || nbytes < 2 * stripe_floor_) return {{0, nbytes}};
-    size_t k = std::min(lanes_, std::max<size_t>(1, nbytes / stripe_floor_));
+    size_t lanes = lanes_, stripe_floor = stripe_floor_;  // one read each
+    if (lanes <= 1 || nbytes < 2 * stripe_floor) return {{0, nbytes}};
+    size_t k = std::min(lanes, std::max<size_t>(1, nbytes / stripe_floor));
     if (k <= 1) return {{0, nbytes}};
     std::vector<size_t> bounds{0};
     for (size_t i = 1; i < k; ++i) {
@@ -1076,7 +1109,8 @@ class Communicator {
   // In-place ring allreduce over a contiguous buffer.
   void allreduce(void* data, size_t nbytes, DType dt, RedOp op) {
     ScatterView view(data, nbytes);
-    allreduce_ring(view, dt, op, full_ring());
+    IoPtr io = io_snapshot();
+    allreduce_ring_io(io, view, dt, op, full_ring(io->world));
   }
 
   // In-place ring allreduce over MANY caller buffers treated as one
@@ -1088,7 +1122,8 @@ class Communicator {
   void allreduce_iov(void* const* bufs, const uint64_t* lens, size_t n,
                      DType dt, RedOp op) {
     ScatterView view(bufs, lens, n);
-    allreduce_ring(view, dt, op, full_ring());
+    IoPtr io = io_snapshot();
+    allreduce_ring_io(io, view, dt, op, full_ring(io->world));
   }
 
   // Ring allreduce over a RANK SUBSET (global ranks in ring order) — the
@@ -1104,8 +1139,12 @@ class Communicator {
 
   void allreduce_ring(ScatterView& view, DType dt, RedOp op,
                       const std::vector<int64_t>& ring) {
+    allreduce_ring_io(io_snapshot(), view, dt, op, ring);
+  }
+
+  void allreduce_ring_io(IoPtr io, ScatterView& view, DType dt, RedOp op,
+                         const std::vector<int64_t>& ring) {
     if (ring.size() <= 1) return;
-    IoPtr io = io_snapshot();
     size_t esz = dtype_size(dt);
     auto deadline = deadline_in(timeout_s_);
     auto bounds = ring_bounds(view.size() / esz, ring.size());
@@ -1129,31 +1168,32 @@ class Communicator {
   // up fully reduced and is copied into `out`.  Returns the chunk's bytes.
   size_t reduce_scatter(void* data, size_t nbytes, DType dt, RedOp op,
                         void* out, size_t out_cap) {
+    IoPtr io = io_snapshot();
+    const int64_t rank = io->rank, ws = io->world;
     size_t esz = dtype_size(dt);
-    auto bounds = ring_bounds(nbytes / esz);
+    auto bounds = ring_bounds(nbytes / esz, static_cast<size_t>(ws));
     uint8_t* bytes = static_cast<uint8_t*>(data);
-    size_t own_off = bounds[rank_] * esz;
-    size_t own_bytes = (bounds[rank_ + 1] - bounds[rank_]) * esz;
+    size_t own_off = bounds[rank] * esz;
+    size_t own_bytes = (bounds[rank + 1] - bounds[rank]) * esz;
     if (own_bytes > out_cap)
       throw CommError("reduce_scatter out buffer too small");
-    if (world_size_ > 1) {
-      IoPtr io = io_snapshot();
+    if (ws > 1) {
       auto deadline = deadline_in(timeout_s_);
       ScatterView view(data, nbytes);
       // shift -1: rank ends owning chunk `rank` (conventional contract);
       // the explicit-API tag window keeps these frames clear of allreduce
       ring_reduce_phase(io, view, bounds, esz, dt, op, /*shift=*/-1, deadline,
-                        full_ring(), kRingReduceTagBase);
+                        full_ring(ws), kRingReduceTagBase);
     }
     std::memcpy(out, bytes + own_off, own_bytes);
     return own_bytes;
   }
 
   void broadcast(void* data, size_t nbytes, int64_t root) {
-    if (world_size_ <= 1) return;
     IoPtr io = io_snapshot();
+    if (io->world <= 1) return;
     auto deadline = deadline_in(timeout_s_);
-    if (rank_ == root) {
+    if (io->rank == root) {
       // concurrent fan-out to every peer (send-only multi_exchange)
       uint8_t* src = static_cast<uint8_t*>(data);
       multi_exchange(
@@ -1176,8 +1216,8 @@ class Communicator {
     std::vector<struct iovec> payload;
     if (nbytes)
       payload.push_back({const_cast<void*>(data), nbytes});
-    send_framed_iov(*io, p2p_fd(dst), dst, tag, std::move(payload), nbytes,
-                    deadline, io->lanes - 1);
+    send_framed_iov(*io, peer_fd(dst, io->lanes - 1), dst, tag,
+                    std::move(payload), nbytes, deadline, io->lanes - 1);
   }
 
   // zero-copy: receive one frame directly into a caller buffer; returns
@@ -1186,7 +1226,7 @@ class Communicator {
     IoPtr io = io_snapshot();
     size_t p2p_lane = io->lanes - 1;
     auto deadline = deadline_in(timeout_s_);
-    int fd = p2p_fd(src);
+    int fd = peer_fd(src, p2p_lane);
     uint64_t hdr[2];
     recv_loop(*io, fd, src, hdr, 16, deadline, p2p_lane);
     if (hdr[1] != tag)
@@ -1212,7 +1252,7 @@ class Communicator {
     IoPtr io = io_snapshot();
     size_t p2p_lane = io->lanes - 1;
     auto deadline = deadline_in(timeout_s_);
-    int fd = p2p_fd(src);
+    int fd = peer_fd(src, p2p_lane);
     uint64_t hdr[2];
     recv_loop(*io, fd, src, hdr, 16, deadline, p2p_lane);
     if (hdr[1] != tag)
@@ -1225,19 +1265,24 @@ class Communicator {
   // symmetric alltoall of equal-size chunks; chunks laid out contiguously in
   // `data` (ws chunks of chunk_bytes); received into `out` by source rank.
   void alltoall(const void* data, void* out, size_t chunk_bytes, uint64_t tag) {
+    IoPtr io = io_snapshot();
     const uint8_t* in = static_cast<const uint8_t*>(data);
-    std::vector<const void*> ins(static_cast<size_t>(world_size_));
-    for (int64_t p = 0; p < world_size_; ++p) ins[p] = in + p * chunk_bytes;
-    alltoall_ptrs(ins.data(), out, chunk_bytes, tag);
+    std::vector<const void*> ins(static_cast<size_t>(io->world));
+    for (int64_t p = 0; p < io->world; ++p) ins[p] = in + p * chunk_bytes;
+    alltoall_ptrs_io(io, ins.data(), out, chunk_bytes, tag);
   }
 
   // scatter-gather alltoall: one pointer per destination rank's chunk (the
   // chunks need not be contiguous with each other — no staging concat)
   void alltoall_ptrs(const void* const* ins, void* out, size_t chunk_bytes,
                      uint64_t tag) {
+    alltoall_ptrs_io(io_snapshot(), ins, out, chunk_bytes, tag);
+  }
+
+  void alltoall_ptrs_io(IoPtr io, const void* const* ins, void* out,
+                        size_t chunk_bytes, uint64_t tag) {
     uint8_t* o = static_cast<uint8_t*>(out);
-    std::memcpy(o + rank_ * chunk_bytes, ins[rank_], chunk_bytes);
-    IoPtr io = io_snapshot();
+    std::memcpy(o + io->rank * chunk_bytes, ins[io->rank], chunk_bytes);
     auto deadline = deadline_in(timeout_s_);
     // pairwise exchange with every peer concurrently
     multi_exchange(
@@ -1251,10 +1296,10 @@ class Communicator {
   }
 
   void allgather(const void* data, void* out, size_t chunk_bytes, uint64_t tag) {
+    IoPtr io = io_snapshot();
     const uint8_t* in = static_cast<const uint8_t*>(data);
     uint8_t* o = static_cast<uint8_t*>(out);
-    std::memcpy(o + rank_ * chunk_bytes, in, chunk_bytes);
-    IoPtr io = io_snapshot();
+    std::memcpy(o + io->rank * chunk_bytes, in, chunk_bytes);
     auto deadline = deadline_in(timeout_s_);
     multi_exchange(
         io, peers_snapshot(),
@@ -1293,11 +1338,6 @@ class Communicator {
                       (aborted_ ? " (communicator aborted)" : ""));
     return it->second[lane];
   }
-
-  // point-to-point ops ride the LAST lane whole (the only lane at lanes==1,
-  // wire-identical to the pre-lane build) — heal traffic off lane 0, where
-  // collective control frames concentrate; matches _TcpMesh.p2p_sock
-  int p2p_fd(int64_t peer) { return peer_fd(peer, lanes_ - 1); }
 
   std::shared_ptr<LanePool> pool_snapshot() {
     std::lock_guard<std::mutex> lock(state_mu_);
@@ -1634,10 +1674,6 @@ class Communicator {
   }
 
   // element bounds per ring chunk (first n%ws chunks one element longer)
-  std::vector<size_t> ring_bounds(size_t n) const {
-    return ring_bounds(n, static_cast<size_t>(world_size_));
-  }
-
   static std::vector<size_t> ring_bounds(size_t n, size_t ws) {
     std::vector<size_t> bounds(ws + 1, 0);
     size_t base = n / ws, extra = n % ws;
@@ -1646,9 +1682,9 @@ class Communicator {
     return bounds;
   }
 
-  std::vector<int64_t> full_ring() const {
-    std::vector<int64_t> ring(world_size_);
-    for (int64_t i = 0; i < world_size_; ++i) ring[i] = i;
+  static std::vector<int64_t> full_ring(int64_t ws) {
+    std::vector<int64_t> ring(ws);
+    for (int64_t i = 0; i < ws; ++i) ring[i] = i;
     return ring;
   }
 
@@ -1671,7 +1707,7 @@ class Communicator {
                          TimePoint deadline, const std::vector<int64_t>& ring,
                          uint64_t tag_base) {
     int64_t ws = static_cast<int64_t>(ring.size());
-    int64_t pos = ring_pos(ring, rank_);
+    int64_t pos = ring_pos(ring, io->rank);
     int64_t right = ring[(pos + 1) % ws];
     int64_t left = ring[(pos - 1 + ws) % ws];
     auto chunk_off = [&](int64_t i) {
@@ -1713,7 +1749,7 @@ class Communicator {
                             const std::vector<int64_t>& ring,
                             uint64_t tag_base) {
     int64_t ws = static_cast<int64_t>(ring.size());
-    int64_t pos = ring_pos(ring, rank_);
+    int64_t pos = ring_pos(ring, io->rank);
     int64_t right = ring[(pos + 1) % ws];
     int64_t left = ring[(pos - 1 + ws) % ws];
     auto chunk_off = [&](int64_t i) {
@@ -1859,11 +1895,17 @@ class Communicator {
     latch->wait();
   }
 
-  double timeout_s_;
-  int64_t rank_ = 0;
-  int64_t world_size_ = 1;
-  size_t lanes_ = 1;
-  size_t stripe_floor_ = kMinStripeBytes;
+  // epoch-scalar mirrors for the PUBLIC accessors (rank()/size()/lanes()/
+  // stripe_floor()/lane_parts()): written only by configure()'s publish
+  // step, read by the binding from foreign threads — atomics because those
+  // reads race the publish.  Op bodies never touch these: they read the
+  // EpochIO snapshot, whose rank/world/lanes are immutable per epoch, so a
+  // superseded op can never mix two epochs' values inside one collective.
+  std::atomic<double> timeout_s_;
+  std::atomic<int64_t> rank_{0};
+  std::atomic<int64_t> world_size_{1};
+  std::atomic<size_t> lanes_{1};
+  std::atomic<size_t> stripe_floor_{kMinStripeBytes};
   std::atomic<bool> aborted_{false};
   // guards peers_/graveyard_/pool_/io_ STRUCTURE only — never held across
   // IO; ops snapshot the fds/pool/io they need at entry (fds stay open

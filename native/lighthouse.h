@@ -181,7 +181,7 @@ class LighthouseServer {
     }
   }
 
-  void register_member(const QuorumMember& m) {
+  void register_member_locked(const QuorumMember& m) {
     auto now = Clock::now();
     state_.heartbeats[m.replica_id] = now;  // implicit heartbeat
     state_.participants[m.replica_id] = MemberDetails{now, m};
@@ -222,7 +222,7 @@ class LighthouseServer {
     std::set<std::string> included;
     for (const auto& p : participants) included.insert(p.replica_id);
     for (const auto& [token, member] : parked_)
-      if (!included.count(member.replica_id)) register_member(member);
+      if (!included.count(member.replica_id)) register_member_locked(member);
 
     generation_ += 1;
     cv_.notify_all();
@@ -283,7 +283,7 @@ class LighthouseServer {
     uint64_t token = next_token_++;
     {
       std::unique_lock<std::mutex> lock(mu_);
-      register_member(requester);
+      register_member_locked(requester);
       parked_[token] = requester;
       uint64_t gen = generation_;
       tick_locked();  // proactive tick
@@ -421,6 +421,7 @@ class LighthouseServer {
   std::thread accept_thread_;
   std::thread tick_thread_;
 
+  // guards state_/parked_/generation_
   std::mutex mu_;
   std::condition_variable cv_;
   LighthouseState state_;

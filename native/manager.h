@@ -406,6 +406,9 @@ class ManagerServer {
   std::thread accept_thread_;
   std::thread heartbeat_thread_;
 
+  // guards participants_/checkpoint_metadata_/quorum_gen_/latest_ok_/
+  // latest_/latest_err_/commit_votes_/commit_failures_/commit_gen_/
+  // commit_decision_
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<int64_t, QuorumMember> participants_;
@@ -419,6 +422,7 @@ class ManagerServer {
   uint64_t commit_gen_ = 0;
   bool commit_decision_ = false;
   ConnRegistry conns_;
+  // guards lh_fd_
   std::mutex lh_fd_mu_;
   int lh_fd_ = -1;
 };

@@ -165,6 +165,7 @@ class StoreServer {
   int port_ = 0;
   std::atomic<bool> shutdown_{false};
   std::thread accept_thread_;
+  // guards data_
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, std::string> data_;

@@ -304,6 +304,7 @@ class ConnRegistry {
   }
 
  private:
+  // guards fds_ (the counter below is atomic: the waiter polls it lock-free)
   std::mutex mu_;
   std::set<int> fds_;
   std::atomic<int> active_{0};

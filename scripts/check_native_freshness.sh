@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Freshness gate for the native binary (CI runs this before the test
+# suite): libtpuft.so is a LOCAL build artifact (gitignored, built on
+# demand by native.py and cached beside the sources), which means a stale
+# artifact — restored from a CI cache, or left over on a dev machine from
+# an old checkout — would be silently loaded in place of the current
+# comm.h/api.cc.  This script guarantees the suite that runs next tests
+# the sources:
+#
+#  - no artifact present (fresh CI checkout): build it, done;
+#  - artifact present: rebuild from source and fail when the existing
+#    binary's exported C ABI has drifted from the rebuild.
+#
+# The comparison is over the `tpuft_*` extern "C" symbols — the exact
+# surface the ctypes binding (torchft_tpu/native.py) loads.  Mangled C++
+# symbols are deliberately excluded: which template instantiations get
+# emitted varies with compiler version and -march, so they would flake
+# across runners without catching anything the C ABI misses.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+sym() { nm -D --defined-only "$1" | awk '$3 ~ /^tpuft_/ {print $3}' | sort; }
+
+if [[ ! -f native/libtpuft.so ]]; then
+  make -C native libtpuft.so > /dev/null
+  echo "check_native_freshness: no prior artifact — built fresh" \
+       "($(sym native/libtpuft.so | wc -l) tpuft_* symbols)"
+  exit 0
+fi
+
+existing=$(mktemp)
+fresh=$(mktemp)
+trap 'rm -f "$existing" "$fresh"' EXIT
+
+sym native/libtpuft.so > "$existing"
+make -C native -B libtpuft.so > /dev/null
+sym native/libtpuft.so > "$fresh"
+
+if ! diff -u --label existing-artifact --label rebuilt "$existing" "$fresh"; then
+  cat >&2 <<'EOF'
+check_native_freshness: the existing native/libtpuft.so artifact no longer
+matches a build from comm.h/api.cc — it was stale and would have shadowed
+the sources.  The fresh build is now in place; rerun whatever loaded the
+old one.
+EOF
+  exit 1
+fi
+echo "check_native_freshness: OK ($(wc -l < "$fresh") tpuft_* symbols)"

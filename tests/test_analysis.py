@@ -16,7 +16,15 @@ import textwrap
 
 import pytest
 
-from torchft_tpu.analysis import core, knobcheck, nativemirror, threads, wireproto
+from torchft_tpu.analysis import (
+    concurrency,
+    core,
+    knobcheck,
+    nativelocks,
+    nativemirror,
+    threads,
+    wireproto,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -230,6 +238,527 @@ class TestThreadSafety:
         pragmas = core.pragma_lines(source)
         live = [f for f in findings if not core.is_suppressed(f, pragmas)]
         assert len(findings) == 2 and len(live) == 1
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+
+def _conc(snippet: str, checker: str):
+    return concurrency.check_source(
+        textwrap.dedent(snippet), "fixture.py", (checker,)
+    )
+
+
+class TestLockOrder:
+    def test_ab_ba_cycle_flagged(self):
+        findings = _conc(
+            """
+            class S:
+                def a_then_b(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            self._x = 1
+
+                def b_then_a(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            self._x = 2
+            """,
+            "lock-order",
+        )
+        assert len(findings) == 1
+        assert "conflicting orders" in findings[0].message
+        assert "_a_lock" in findings[0].symbol and "_b_lock" in findings[0].symbol
+
+    def test_cycle_through_method_call_flagged(self):
+        # the cross-method shape: A held, self._helper() acquires B; another
+        # path takes B then A — invisible to a single-scope scan
+        findings = _conc(
+            """
+            class S:
+                def outer(self):
+                    with self._a_lock:
+                        self._helper()
+
+                def _helper(self):
+                    with self._b_lock:
+                        self._x = 1
+
+                def other(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            self._x = 2
+            """,
+            "lock-order",
+        )
+        assert len(findings) == 1
+        assert "conflicting orders" in findings[0].message
+
+    def test_consistent_order_passes(self):
+        findings = _conc(
+            """
+            class S:
+                def one(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            self._x = 1
+
+                def two(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            self._x = 2
+            """,
+            "lock-order",
+        )
+        assert findings == []
+
+    def test_plain_lock_reentry_flagged(self):
+        findings = _conc(
+            """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        self._x = 1
+            """,
+            "lock-order",
+        )
+        assert len(findings) == 1
+        assert "not reentrant" in findings[0].message
+
+    def test_rlock_and_condition_reentry_pass(self):
+        findings = _conc(
+            """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._cv = threading.Condition()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+                    with self._cv:
+                        self._notify()
+
+                def _inner(self):
+                    with self._lock:
+                        self._x = 1
+
+                def _notify(self):
+                    with self._cv:
+                        self._cv.notify_all()
+            """,
+            "lock-order",
+        )
+        assert findings == []
+
+    def test_unknown_ctor_reentry_stays_quiet(self):
+        # lock type unseen (injected) — conservative: no self-deadlock claim
+        findings = _conc(
+            """
+            class S:
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        self._x = 1
+            """,
+            "lock-order",
+        )
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock_flagged(self):
+        findings = _conc(
+            """
+            import time
+
+            class S:
+                def poll(self):
+                    with self._lock:
+                        time.sleep(0.5)
+            """,
+            "blocking-under-lock",
+        )
+        assert len(findings) == 1
+        assert "time.sleep()" in findings[0].message
+
+    def test_rpc_through_helper_under_lock_flagged(self):
+        # the quorum-wedge shape: the lock is held across a helper whose
+        # closure does the actual client round-trip
+        findings = _conc(
+            """
+            class S:
+                def run(self):
+                    with self._client_lock:
+                        self._fetch()
+
+                def _fetch(self):
+                    return self._lh_client.quorum(timeout=1.0)
+            """,
+            "blocking-under-lock",
+        )
+        assert len(findings) == 1
+        assert "self._fetch()" in findings[0].message
+        assert "RPC" in findings[0].message
+
+    def test_future_result_and_event_wait_under_lock_flagged(self):
+        findings = _conc(
+            """
+            class S:
+                def a(self):
+                    with self._lock:
+                        return self._fut.result()
+
+                def b(self):
+                    with self._lock:
+                        self._done_event.wait(1.0)
+            """,
+            "blocking-under-lock",
+        )
+        descs = {f.message for f in findings}
+        assert len(findings) == 2
+        assert any("Future.result()" in d for d in descs)
+        assert any("wait()" in d for d in descs)
+
+    def test_cv_wait_on_held_lock_passes(self):
+        # cv.wait RELEASES the lock it waits on — the one blocking call
+        # that is correct under its own lock
+        findings = _conc(
+            """
+            class S:
+                def park(self):
+                    with self._lock:
+                        while not self._ready:
+                            self._lock.wait(0.1)
+            """,
+            "blocking-under-lock",
+        )
+        assert findings == []
+
+    def test_blocking_outside_lock_passes(self):
+        findings = _conc(
+            """
+            import time
+
+            class S:
+                def run(self):
+                    with self._lock:
+                        self._n += 1
+                    time.sleep(0.5)
+                    self._sock.recv(1024)
+            """,
+            "blocking-under-lock",
+        )
+        assert findings == []
+
+    def test_str_join_not_confused_with_thread_join(self):
+        findings = _conc(
+            """
+            class S:
+                def render(self):
+                    with self._lock:
+                        return ", ".join(self._parts)
+
+                def reap(self):
+                    with self._lock:
+                        self._thread.join()
+            """,
+            "blocking-under-lock",
+        )
+        assert len(findings) == 1
+        assert findings[0].symbol.endswith("join()")
+        assert "reap" in findings[0].symbol
+
+
+# ---------------------------------------------------------------------------
+# executor-starvation
+# ---------------------------------------------------------------------------
+
+
+class TestExecutorStarvation:
+    def test_submit_from_executor_context_flagged(self):
+        findings = _conc(
+            """
+            import concurrent.futures
+
+            class S:
+                def __init__(self):
+                    self._executor = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=1
+                    )
+
+                def kick(self):
+                    self._executor.submit(self._task)
+
+                def _task(self):
+                    self._executor.submit(self._cleanup).result()
+
+                def _cleanup(self):
+                    pass
+            """,
+            "executor-starvation",
+        )
+        assert len(findings) == 1
+        assert findings[0].symbol == "S._task._executor"
+
+    def test_transitive_submit_flagged(self):
+        # the submit hides one call deeper: _task -> _stage -> submit
+        findings = _conc(
+            """
+            import concurrent.futures
+
+            class S:
+                def __init__(self):
+                    self._executor = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=1
+                    )
+
+                def kick(self):
+                    self._executor.submit(self._task)
+
+                def _task(self):
+                    self._stage()
+
+                def _stage(self):
+                    self._executor.submit(self._cleanup)
+
+                def _cleanup(self):
+                    pass
+            """,
+            "executor-starvation",
+        )
+        assert len(findings) == 1
+        assert findings[0].symbol == "S._stage._executor"
+
+    def test_submit_from_caller_context_passes(self):
+        # the manager.py shape: the train thread submits the quorum AND the
+        # warm staging; neither submitted task submits again
+        findings = _conc(
+            """
+            import concurrent.futures
+
+            class S:
+                def __init__(self):
+                    self._executor = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=1
+                    )
+
+                def start_round(self):
+                    self._executor.submit(self._async_quorum)
+                    self._maybe_stage()
+
+                def _maybe_stage(self):
+                    self._executor.submit(self._stage_now)
+
+                def _async_quorum(self):
+                    self._n += 1
+
+                def _stage_now(self):
+                    self._m += 1
+            """,
+            "executor-starvation",
+        )
+        assert findings == []
+
+    def test_multi_worker_executor_passes(self):
+        findings = _conc(
+            """
+            import concurrent.futures
+
+            class S:
+                def __init__(self):
+                    self._pool = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=4
+                    )
+
+                def kick(self):
+                    self._pool.submit(self._task)
+
+                def _task(self):
+                    self._pool.submit(self._cleanup)
+
+                def _cleanup(self):
+                    pass
+            """,
+            "executor-starvation",
+        )
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# native-locks
+# ---------------------------------------------------------------------------
+
+
+class TestNativeLocks:
+    GUARDED_BAD = (
+        "class C {\n"
+        " public:\n"
+        "  void unlocked_touch() { peers_.clear(); }\n"
+        "  void locked_elsewhere() {\n"
+        "    std::lock_guard<std::mutex> lock(state_mu_);\n"
+        "  }\n"
+        " private:\n"
+        "  // guards peers_\n"
+        "  std::mutex state_mu_;\n"
+        "  std::map<int, int> peers_;\n"
+        "};\n"
+    )
+
+    def test_guarded_member_use_without_lock_flagged(self):
+        findings = nativelocks.check_text(self.GUARDED_BAD, "native/c.h")
+        assert len(findings) == 1
+        assert findings[0].symbol == "guards.peers_"
+
+    def test_guarded_member_use_under_lock_passes(self):
+        good = self.GUARDED_BAD.replace(
+            "  void unlocked_touch() { peers_.clear(); }\n",
+            "  void locked_touch() {\n"
+            "    std::lock_guard<std::mutex> lock(state_mu_);\n"
+            "    peers_.clear();\n"
+            "  }\n",
+        )
+        assert nativelocks.check_text(good, "native/c.h") == []
+
+    def test_locked_suffix_function_exempt(self):
+        good = self.GUARDED_BAD.replace(
+            "  void unlocked_touch() { peers_.clear(); }\n",
+            "  void touch_locked() { peers_.clear(); }\n",
+        )
+        assert nativelocks.check_text(good, "native/c.h") == []
+
+    def test_raw_snapshot_deref_flagged(self):
+        text = (
+            "class C {\n"
+            "  IoPtr io_snapshot() {\n"
+            "    std::lock_guard<std::mutex> lock(mu_);\n"
+            "    return io_;\n"
+            "  }\n"
+            "  void op() { io_->gate(); }\n"
+            "  std::mutex mu_;\n"
+            "  IoPtr io_;\n"
+            "};\n"
+        )
+        findings = nativelocks.check_text(text, "native/c.h")
+        assert any(f.symbol == "snapshot.io_" for f in findings)
+
+    def test_snapshot_copy_under_lock_passes(self):
+        text = (
+            "class C {\n"
+            "  IoPtr io_snapshot() {\n"
+            "    std::lock_guard<std::mutex> lock(mu_);\n"
+            "    return io_;\n"
+            "  }\n"
+            "  void op() { IoPtr io = io_snapshot(); io->gate(); }\n"
+            "  std::mutex mu_;\n"
+            "  IoPtr io_;\n"
+            "};\n"
+        )
+        assert nativelocks.check_text(text, "native/c.h") == []
+
+    def test_dead_mutex_flagged(self):
+        findings = nativelocks.check_text(
+            "class C {\n  std::mutex dead_mu_;\n  int x_ = 0;\n};\n",
+            "native/c.h",
+        )
+        assert [f.symbol for f in findings] == ["mutex.dead_mu_"]
+
+    def test_cv_wait_keeps_mutex_live(self):
+        text = (
+            "class C {\n"
+            "  void park() {\n"
+            "    std::unique_lock<std::mutex> lock(mu_);\n"
+            "    cv_.wait(lock);\n"
+            "  }\n"
+            "  std::mutex mu_;\n"
+            "  std::condition_variable cv_;\n"
+            "};\n"
+        )
+        assert nativelocks.check_text(text, "native/c.h") == []
+
+    def test_atomic_memcpy_flagged(self):
+        text = (
+            "struct B {\n"
+            "  std::atomic<uint64_t> ctr_{0};\n"
+            "  void snap(void* dst) { std::memcpy(dst, &ctr_, 8); }\n"
+            "  std::mutex mu_;\n"
+            "  void ok() { std::lock_guard<std::mutex> l(mu_); }\n"
+            "};\n"
+        )
+        findings = nativelocks.check_text(text, "native/c.h")
+        assert [f.symbol for f in findings] == ["atomic.ctr_"]
+
+    def test_atomic_plain_shadow_flagged(self):
+        text = (
+            "struct B {\n"
+            "  std::atomic<bool> stop_{false};\n"
+            "  bool stop_ = false;\n"
+            "  std::mutex mu_;\n"
+            "  void ok() { std::lock_guard<std::mutex> l(mu_); }\n"
+            "};\n"
+        )
+        findings = nativelocks.check_text(text, "native/c.h")
+        assert any(
+            f.symbol == "atomic.stop_" and "shadow" in f.message
+            for f in findings
+        )
+
+    def test_multiline_guards_annotation_fully_parsed(self):
+        # members wrapped onto // continuation lines must stay enforced —
+        # a first-line-only parse would silently drop them
+        text = (
+            "class C {\n"
+            "  void bad() { wrapped_member_ = 1; }\n"
+            "  void ok() { std::lock_guard<std::mutex> l(mu_); }\n"
+            "  // guards first_member_/\n"
+            "  // wrapped_member_\n"
+            "  std::mutex mu_;\n"
+            "  int first_member_ = 0;\n"
+            "  int wrapped_member_ = 0;\n"
+            "};\n"
+        )
+        assert nativelocks._guard_map(text) == {
+            "first_member_": "mu_",
+            "wrapped_member_": "mu_",
+        }
+        findings = nativelocks.check_text(text, "native/c.h")
+        assert [f.symbol for f in findings] == ["guards.wrapped_member_"]
+
+    def test_cpp_pragma_suppresses(self):
+        source = self.GUARDED_BAD.replace(
+            "  void unlocked_touch() { peers_.clear(); }\n",
+            "  // ftlint: ignore[native-locks] — test pragma\n"
+            "  void unlocked_touch() { peers_.clear(); }\n",
+        )
+        findings = nativelocks.check_text(source, "native/c.h")
+        pragmas = core.pragma_lines(source)
+        assert len(findings) == 1
+        assert core.is_suppressed(findings[0], pragmas)
+
+    def test_real_native_headers_clean(self):
+        findings = nativelocks.check(REPO)
+        assert findings == [], "\n".join(f.render() for f in findings)
 
 
 # ---------------------------------------------------------------------------
@@ -578,6 +1107,47 @@ class TestInfrastructure:
         path = tmp_path / "baseline.json"
         path.write_text('["c:f.py:s:abc123"]')
         assert core.load_baseline(str(path)) == ["c:f.py:s:abc123"]
+
+    def test_json_format_emits_full_run(self, capsys, monkeypatch):
+        from torchft_tpu.analysis import __main__ as cli
+
+        new = core.Finding("c", "f.py", 2, "sym", "fresh")
+        supp = core.Finding("c", "f.py", 9, "other", "excused")
+        result = core.RunResult(new=[new], suppressed=[supp])
+        monkeypatch.setattr(cli, "run_checkers", lambda **kw: result)
+        rc = cli.main(["--format", "json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert payload["counts"] == {"new": 1, "suppressed": 1, "baselined": 0}
+        by_disp = {row["disposition"]: row for row in payload["findings"]}
+        assert by_disp["new"]["fingerprint"] == new.fingerprint
+        assert by_disp["suppressed"]["symbol"] == "other"
+
+    def test_github_format_annotates_new_findings_only(
+        self, capsys, monkeypatch
+    ):
+        from torchft_tpu.analysis import __main__ as cli
+
+        new = core.Finding("lock-order", "a.py", 7, "s", "cycle here")
+        supp = core.Finding("lock-order", "a.py", 9, "t", "excused")
+        result = core.RunResult(new=[new], suppressed=[supp])
+        monkeypatch.setattr(cli, "run_checkers", lambda **kw: result)
+        rc = cli.main(["--format", "github"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert out.splitlines() == [
+            "::error file=a.py,line=7,title=ftlint lock-order::cycle here"
+        ]
+
+    def test_github_format_clean_run_is_silent_and_zero(
+        self, capsys, monkeypatch
+    ):
+        from torchft_tpu.analysis import __main__ as cli
+
+        monkeypatch.setattr(cli, "run_checkers", lambda **kw: core.RunResult())
+        rc = cli.main(["--format", "github"])
+        assert rc == 0
+        assert capsys.readouterr().out == ""
 
     def test_write_baseline_preserves_still_firing_entries(
         self, tmp_path, monkeypatch

@@ -300,6 +300,50 @@ class TestResiliency:
         comm.shutdown()
 
 
+class TestInflightOpsCounter:
+    """Regression pin for the PR-6 third-round ``_inflight_ops`` fix: the
+    busy() counter rides its OWN lock because old- and new-epoch op threads
+    overlap (teardown queues a sentinel but never joins), and an
+    unsynchronized ``+=`` / ``-=`` pair can lose an update either way —
+    sticking busy() True forever (spare warm serving waits the full yield
+    window on every request) or letting it underflow (warm serving never
+    yields to live collectives).  Two threads hammer the exact
+    ``_op_started`` / ``_op_finished`` protocol ``_run_ops`` uses; after
+    every paired enter/exit the counter must be back at idle."""
+
+    HAMMER = 20_000
+
+    def _hammer(self, comm) -> None:
+        barrier = threading.Barrier(2)
+
+        def slam() -> None:
+            barrier.wait()
+            for _ in range(self.HAMMER):
+                comm._op_started()
+                comm._op_finished()
+
+        threads = [threading.Thread(target=slam) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert comm._inflight_ops == 0, (
+            f"lost update under contention: counter at {comm._inflight_ops} "
+            f"after {2 * self.HAMMER} paired ops"
+        )
+        assert comm.busy() is False
+
+    def test_tcp_counter_survives_contention(self) -> None:
+        self._hammer(TCPCommunicator(timeout_s=1.0))
+
+    def test_cpp_counter_survives_contention(self) -> None:
+        from torchft_tpu import native
+
+        if not native.available():
+            pytest.skip("native runtime unavailable")
+        self._hammer(native.CppCommunicator(timeout_s=1.0))
+
+
 def test_dummy_communicator() -> None:
     comm = DummyCommunicator()
     data = np.arange(5, dtype=np.float32)

@@ -172,7 +172,7 @@ class TestDiLoCo:
             manager, holder, optax.sgd(1.0), sync_every=4, num_fragments=2
         )
         results = []
-        for step in range(8):
+        for _step in range(8):
             holder["params"] = jax.tree_util.tree_map(
                 lambda p: p - 1.0, holder["params"]
             )
@@ -232,7 +232,8 @@ class TestDiLoCoRegression:
         for step in range(9):
             # deterministic synthetic grads
             grads = jax.tree_util.tree_map(
-                lambda p: 0.05 * (jnp.ones_like(p) + 0.1 * step), holder["params"]
+                lambda p, step=step: 0.05 * (jnp.ones_like(p) + 0.1 * step),
+                holder["params"],
             )
             updates, inner_state = inner_tx.update(
                 grads, inner_state, holder["params"]

@@ -375,7 +375,7 @@ class TestCppCommunicator:
             return out, time.monotonic() - t0
 
         results = _run_ranks(cpp_store, 2, _fn, timeout_s=60.0)
-        for res, dt in results:
+        for res, _dt in results:
             np.testing.assert_allclose(res[:5], np.full(5, 3.0))
         # native tier should move 8MB over loopback quickly
         assert results[0][1] < 5.0

@@ -231,7 +231,7 @@ class TestShardedPipeline:
             assert len(seen) > 1, "expected a multi-chunk pipeline"
             assert seen[0][0] == rank * per
             assert seen[-1][1] == rank * per + per
-            for (a0, a1), (b0, b1) in zip(seen, seen[1:]):
+            for (_a0, a1), (b0, _b1) in zip(seen, seen[1:]):
                 assert a1 == b0, "chunks must tile the shard in order"
 
 
@@ -272,7 +272,7 @@ def _trajectory(monkeypatch, mode: str, steps: int = 6) -> np.ndarray:
     )
     for step in range(steps):
         holder["params"] = jax.tree_util.tree_map(
-            lambda p: p - 0.05 * (1.0 + 0.1 * step), holder["params"]
+            lambda p, step=step: p - 0.05 * (1.0 + 0.1 * step), holder["params"]
         )
         diloco.step()
     return np.concatenate(

@@ -53,7 +53,7 @@ class TestGke:
     def test_manifests_parse_and_carry_contract(self) -> None:
         rendered = render_gke(_spec(tpu_chips=8))
         assert len(rendered) == 3
-        for rid, (name, manifest) in enumerate(rendered):
+        for rid, (_name, manifest) in enumerate(rendered):
             doc = yaml.safe_load(manifest)
             assert doc["kind"] == "Job"
             assert doc["metadata"]["name"] == f"torchft-tpu-rg{rid}"

@@ -58,7 +58,7 @@ class TestChunkIndex:
             ranges = plan.chunk_ranges(target)
             assert ranges[0][0] == 0
             assert ranges[-1][1] == plan.total_len
-            for (s0, e0), (s1, e1) in zip(ranges, ranges[1:]):
+            for (s0, e0), (s1, _e1) in zip(ranges, ranges[1:]):
                 assert e0 == s1  # contiguous, disjoint
                 assert s0 < e0
 
@@ -84,7 +84,7 @@ class TestChunkIndex:
     def test_small_units_pack_at_unit_boundaries(self) -> None:
         ranges = chunk_ranges(header_len=4, leaf_nbytes=[4, 4, 4], target_bytes=17)
         bounds = {4, 16, 28, 40}  # unit boundaries
-        for s, e in ranges:
+        for s, _e in ranges:
             assert s == 0 or s in bounds
 
     def test_reassembly_from_ranges_bit_identical(self) -> None:

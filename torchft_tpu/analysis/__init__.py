@@ -1,6 +1,6 @@
 """``ftlint`` — repo-specific static analysis for the torchft_tpu stack.
 
-Four AST/text checkers enforce the invariants that keep a heavily
+Eight AST/text checkers enforce the invariants that keep a heavily
 concurrent fault-tolerance control plane coherent, the ones the bug record
 shows reviewers keep having to catch by hand:
 
@@ -10,6 +10,16 @@ shows reviewers keep having to catch by hand:
   reachability) and flags read-modify-write mutations of ``self.*`` state
   reachable from two or more entry points that are not lexically under a
   ``with <lock>`` — the ``_inflight_ops +=`` bug class, found statically.
+- ``lock-order`` (:mod:`.concurrency`): per-class lock-acquisition graph
+  (nested ``with`` scopes, including across ``self._method()`` calls) —
+  cycles are potential deadlocks, and re-acquiring a plain ``Lock`` on the
+  same thread is a certain one.
+- ``blocking-under-lock`` (:mod:`.concurrency`): RPC round-trips, socket
+  IO, ``Future.result()``, ``Event.wait()``, ``join()``, ``time.sleep``
+  reachable while a lock is lexically held — the quorum-wedge shape.
+- ``executor-starvation`` (:mod:`.concurrency`): submits onto a
+  single-thread executor from code that itself runs on that executor
+  (the task queues behind its submitter; waiting on it self-deadlocks).
 - ``wire-protocol`` (:mod:`.wireproto`): every data-plane tag literal must
   come from the central registry in ``wire.py`` (no more scattered 103 /
   880 / 900 / 4000... constants), registered allocations must not collide,
@@ -26,6 +36,10 @@ shows reviewers keep having to catch by hand:
   hello flag, 64-byte stripe alignment, frame cap, message types, the
   ``lane_parts`` / ``outer_shard_parts`` / ``HostTopology`` mirrors) must
   match their Python counterparts so the tiers can't drift apart silently.
+- ``native-locks`` (:mod:`.nativelocks`): C++ lock discipline, textually —
+  ``// guards`` annotations enforced, raw deref of ``*_snapshot()``-style
+  members banned (the torn-``EpochIO``-pointer class), dead mutexes and
+  atomic/plain mixing flagged.
 
 Run ``python -m torchft_tpu.analysis`` from the repo root (CI does).  A
 finding is suppressed either by an inline pragma on its line —
@@ -40,4 +54,13 @@ from torchft_tpu.analysis.core import (  # noqa: F401
     run_checkers,
 )
 
-CHECKERS = ("thread-safety", "wire-protocol", "knob-registry", "native-mirror")
+CHECKERS = (
+    "thread-safety",
+    "lock-order",
+    "blocking-under-lock",
+    "executor-starvation",
+    "wire-protocol",
+    "knob-registry",
+    "native-mirror",
+    "native-locks",
+)

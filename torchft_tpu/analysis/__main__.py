@@ -9,6 +9,7 @@ docs/analysis.md).
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from torchft_tpu.analysis import CHECKERS
@@ -18,6 +19,50 @@ from torchft_tpu.analysis.core import (
     run_checkers,
     save_baseline,
 )
+
+
+def _emit_json(result) -> None:
+    """Machine-readable run result (``--format json``): every finding with
+    its fingerprint and disposition, so CI tooling can diff runs."""
+
+    def row(finding, disposition):
+        return {
+            "checker": finding.checker,
+            "file": finding.file,
+            "line": finding.line,
+            "symbol": finding.symbol,
+            "message": finding.message,
+            "fingerprint": finding.fingerprint,
+            "disposition": disposition,
+        }
+
+    payload = {
+        "findings": [row(f, "new") for f in result.new]
+        + [row(f, "suppressed") for f in result.suppressed]
+        + [row(f, "baselined") for f in result.baselined],
+        "stale_baseline": result.stale_baseline,
+        "counts": {
+            "new": len(result.new),
+            "suppressed": len(result.suppressed),
+            "baselined": len(result.baselined),
+        },
+    }
+    json.dump(payload, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+
+
+def _emit_github(result) -> None:
+    """GitHub Actions workflow-command lines (``--format github``): each new
+    finding becomes an ``::error`` annotation rendered inline on the PR
+    diff.  Only NEW findings annotate — suppressed/baselined debt would be
+    noise on every PR."""
+    for f in sorted(result.new, key=lambda f: (f.file, f.line)):
+        # the message is one line by construction; %, CR and LF would need
+        # workflow-command escaping if that ever changes
+        print(
+            f"::error file={f.file},line={f.line},title=ftlint "
+            f"{f.checker}::{f.message}"
+        )
 
 
 def main(argv=None) -> int:
@@ -42,6 +87,16 @@ def main(argv=None) -> int:
     parser.add_argument(
         "-q", "--quiet", action="store_true", help="findings only, no summary"
     )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json", "github"),
+        default="text",
+        help=(
+            "output format: human text (default), json (full run result "
+            "with fingerprints), or github (::error annotation lines CI "
+            "surfaces inline on PRs)"
+        ),
+    )
     args = parser.parse_args(argv)
 
     root = args.root or repo_root()
@@ -56,6 +111,13 @@ def main(argv=None) -> int:
         save_baseline(baseline_path, keep)
         print(f"ftlint: wrote {len(keep)} suppressions to {baseline_path}")
         return 0
+
+    if args.format == "json":
+        _emit_json(result)
+        return 1 if result.new else 0
+    if args.format == "github":
+        _emit_github(result)
+        return 1 if result.new else 0
 
     for finding in sorted(result.new, key=lambda f: (f.file, f.line)):
         print(finding.render())

@@ -23,7 +23,8 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
-_PRAGMA_RE = re.compile(r"#\s*ftlint:\s*ignore\[([a-z0-9_,\- ]+)\]")
+# pragmas live in `#` comments in Python and `//` comments in the C++ tier
+_PRAGMA_RE = re.compile(r"(?:#|//)\s*ftlint:\s*ignore\[([a-z0-9_,\- ]+)\]")
 
 
 @dataclass
@@ -150,14 +151,25 @@ def run_checkers(
     """Run the requested checkers (default: all four) over the repo at
     ``root`` and partition findings into new / pragma-suppressed /
     baselined."""
-    from torchft_tpu.analysis import knobcheck, nativemirror, threads, wireproto
+    from torchft_tpu.analysis import (
+        concurrency,
+        knobcheck,
+        nativelocks,
+        nativemirror,
+        threads,
+        wireproto,
+    )
 
     root = root or repo_root()
     registry = {
         "thread-safety": threads.check,
+        "lock-order": concurrency.check_lock_order,
+        "blocking-under-lock": concurrency.check_blocking,
+        "executor-starvation": concurrency.check_starvation,
         "wire-protocol": wireproto.check,
         "knob-registry": knobcheck.check,
         "native-mirror": nativemirror.check,
+        "native-locks": nativelocks.check,
     }
     names = list(checkers) if checkers else list(registry)
     unknown = [n for n in names if n not in registry]

@@ -399,6 +399,11 @@ class BabyCommunicator(Communicator):
             fut = Future()
             self._futures[op_id] = fut
             try:
+                # The pipe write must stay ordered with op-id allocation
+                # (the baby matches ops to futures by arrival order);
+                # commands are tens of bytes, so the pipe buffer only fills
+                # if the baby is already dead, and abort() severs the pipe.
+                # ftlint: ignore[blocking-under-lock] — ordered tiny pipe write
                 self._cmd.send((op_id, op, args))
             except (OSError, ValueError) as e:
                 self._futures.pop(op_id, None)

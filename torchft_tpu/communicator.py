@@ -2696,6 +2696,20 @@ class TCPCommunicator(Communicator):
         ops = self._ops
         return ops is not None and not ops.empty()
 
+    def _op_started(self) -> None:
+        """Enter the in-flight window of :meth:`busy`.  The counter rides
+        its own lock because old and new epoch op threads overlap (teardown
+        queues a sentinel but never joins), and an unsynchronized ``+=`` /
+        ``-=`` pair can lose an update either way — sticking ``busy()``
+        above zero forever or letting warm serving never yield (the PR-6
+        third-round fix; pinned by a contention regression test)."""
+        with self._inflight_lock:
+            self._inflight_ops += 1
+
+    def _op_finished(self) -> None:
+        with self._inflight_lock:
+            self._inflight_ops -= 1
+
     def arm_faults(self, spec: Union[str, _FaultProgram, None]) -> None:
         """Arm (or with ``None`` disarm) a per-link fault program at
         runtime — the chaos hook that flips a healthy link flaky
@@ -2856,8 +2870,7 @@ class TCPCommunicator(Communicator):
                     epoch, f"op timed out after {timeout_s}s"
                 ),
             )
-            with self._inflight_lock:
-                self._inflight_ops += 1
+            self._op_started()
             try:
                 result = fn()
             except BaseException as e:  # noqa: BLE001
@@ -2883,8 +2896,7 @@ class TCPCommunicator(Communicator):
             else:
                 fut.set_result(result)
             finally:
-                with self._inflight_lock:
-                    self._inflight_ops -= 1
+                self._op_finished()
                 handle.cancel()
 
     def _submit(

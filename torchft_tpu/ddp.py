@@ -210,7 +210,7 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
 
     works: List[Work] = []
     bucket_layouts: List[List[Tuple[int, int, int, tuple]]] = []
-    for dtype_name, idxs in order.items():
+    for _dtype_name, idxs in order.items():
         group: List[int] = []
         group_bytes = 0
         groups: List[List[int]] = []

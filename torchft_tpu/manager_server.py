@@ -711,12 +711,16 @@ class ManagerServer:
             conn.settimeout(None)
 
     def _run_quorum(self, requester: QuorumMember, timeout_s: float) -> None:
-        """Forward the group's request to the lighthouse with retries
-        (``src/manager.rs:218-306``) and broadcast the result.
+        """Forward the group's request to the lighthouse with retries and
+        broadcast the result to every parked rank.
 
-        Unlike the reference (which leaves waiters to hit their own deadlines
-        when every retry fails — a noted TODO at ``src/manager.rs:238``), we
-        broadcast the failure so parked ranks fail fast.
+        Retry exhaustion is a BROADCAST FAILURE, never a silent park: after
+        ``quorum_retries`` failed attempts (plus any free retries granted by
+        a detected lighthouse restart, bounded only by the caller's
+        deadline), ``_latest`` is cleared, ``_latest_err`` records the last
+        transport error, ``_quorum_gen`` is bumped, and ``_lock`` is
+        notified — so ranks blocked in the quorum wait wake immediately with
+        the error instead of each burning its own full deadline.
         """
         logger.info(
             "[Replica %s] All workers joined - starting quorum", self._replica_id
@@ -741,6 +745,11 @@ class ManagerServer:
                     self._lh_quorum_client = LighthouseClient(
                         self._lighthouse_addr, connect_timeout=self._connect_timeout
                     )
+                # One in-flight lighthouse RPC on the shared persistent
+                # client is this lock's purpose; a parked call is severed by
+                # the heartbeat loop's interrupt() on lighthouse restart
+                # (tested by the lighthouse-bounce unit test).
+                # ftlint: ignore[blocking-under-lock] — serialized rpc by design
                 quorum = self._lh_quorum_client.quorum(
                     replica_id=requester.replica_id,
                     timeout=max(0.1, deadline - time.monotonic()),

@@ -695,6 +695,17 @@ class CppCommunicator(Communicator):
         ops = self._ops
         return ops is not None and not ops.empty()
 
+    def _op_started(self) -> None:
+        """Enter the in-flight window of :meth:`busy` — counter under its
+        own lock; see TCPCommunicator._op_started (same doctrine, pinned by
+        the same contention regression test)."""
+        with self._inflight_lock:
+            self._inflight_ops += 1
+
+    def _op_finished(self) -> None:
+        with self._inflight_lock:
+            self._inflight_ops -= 1
+
     def lane_stats(self) -> Dict[str, object]:
         """Per-lane observability of the current epoch, tier-agnostic with
         :meth:`TCPCommunicator.lane_stats`: lane count, stripe floor,
@@ -749,8 +760,7 @@ class CppCommunicator(Communicator):
                     epoch, f"op timed out after {timeout_s}s"
                 ),
             )
-            with self._inflight_lock:
-                self._inflight_ops += 1
+            self._op_started()
             try:
                 result = fn()
             except BaseException as e:  # noqa: BLE001
@@ -763,8 +773,7 @@ class CppCommunicator(Communicator):
             else:
                 fut.set_result(result)
             finally:
-                with self._inflight_lock:
-                    self._inflight_ops -= 1
+                self._op_finished()
                 handle.cancel()
 
     def _submit(self, fn: Callable[[], object]) -> Work:

@@ -819,15 +819,22 @@ class RpcClient:
         the server acted): safe only for rpcs whose re-execution is
         harmless (heartbeat, status, store get/exists), and exactly what
         keeps a replica alive through a lighthouse connection blip."""
+        # The three blocking-under-lock pragmas below share one reason: this
+        # lock EXISTS to serialize the single-connection round-trip (one
+        # outstanding rpc per client), every call sets a socket deadline
+        # first, and interrupt() closes the socket from another thread to
+        # sever a wedged call — the lock is never held indefinitely.
         with self._lock:
             attempts = 2 if idempotent else 1
             for attempt in range(attempts):
                 if self._sock is None:
+                    # ftlint: ignore[blocking-under-lock] — see above
                     self._sock = connect(self._addr, self._connect_timeout)
                 self._sock.settimeout(timeout + self._headroom_s)
                 try:
+                    # ftlint: ignore[blocking-under-lock] — see above
                     send_frame(self._sock, msg_type, payload)
-                    return recv_frame(self._sock)
+                    return recv_frame(self._sock)  # ftlint: ignore[blocking-under-lock] — see above
                 except socket.timeout as e:
                     self._drop_socket()
                     raise TimeoutError(
