@@ -1221,6 +1221,31 @@ def _run_spare_phase(num_replicas: int = 3, steps: int = 10) -> Dict[str, Any]:
                 os.environ[k] = v
 
 
+def _run_coord_phase(num_replicas: int) -> Dict[str, Any]:
+    """Coordination-plane scale gate (ISSUE 12): the thread-plane harness
+    drives ``num_replicas`` simulated replicas + a spare pool through
+    quorum/kill/rejoin/promote churn and an aggregator bounce against a
+    subprocess lighthouse, reporting p99 quorum latency, lighthouse CPU,
+    and the lighthouse-inbound beat-RPC reduction vs direct heartbeats.
+    Pure control plane — no accelerator, no data plane — so it costs tens
+    of seconds regardless of platform."""
+    from torchft_tpu.coord.scale import run_scale_harness
+
+    try:
+        return run_scale_harness(
+            num_replicas=num_replicas,
+            num_aggregators=2,
+            num_spares=2,
+            kills=1,
+            rejoins=1,
+            agg_bounce=True,
+            deadline_s=150.0,
+        )
+    except Exception as e:  # noqa: BLE001 — a failed phase is a recorded
+        # fact, never a lost artifact
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 _PARTIAL: Dict[str, Any] = {}
 # overridable so a recovery subprocess (see _try_tpu_phase_a) never
 # clobbers the parent run's streaming artifact
@@ -1279,6 +1304,12 @@ def _install_hard_deadline(deadline_ts: float):
             "platform": single.get("platform"),
             "tier": single.get("tier"),
             "mfu": single.get("mfu"),
+            # coordination headline keys land even on a watchdog trip —
+            # they streamed into _PARTIAL the moment the phase finished
+            "coord_p99_quorum_latency_s": _PARTIAL.get(
+                "coord_p99_quorum_latency_s"
+            ),
+            "lighthouse_cpu_frac": _PARTIAL.get("lighthouse_cpu_frac"),
             "deadline_expired": True,
             "phases_done": sorted(
                 k for k in _PARTIAL if k not in ("partial_ts", "final")
@@ -1584,6 +1615,29 @@ def main() -> None:
             _emit_partial(spare_promotion=spare_promotion)
             faults["spare_promotion"] = spare_promotion
 
+    coord: Dict[str, Any] = {}
+    if not os.environ.get("TPUFT_BENCH_SKIP_COORD"):
+        if remaining_s() > 60.0:
+            coord = _run_coord_phase(
+                int(
+                    os.environ.get("TPUFT_BENCH_COORD_REPLICAS", 0)
+                    or (120 if on_cpu else 500)
+                )
+            )
+        else:
+            coord = {
+                "skipped": f"budget exhausted ({remaining_s():.0f}s left)"
+            }
+        print(f"bench: coord {coord}", file=sys.stderr)
+        # the two coordination headline keys stream as TOP-LEVEL partial
+        # keys the moment the phase lands, so a watchdog trip still
+        # reports them (the BENCH_r05 lesson)
+        _emit_partial(
+            coord=coord,
+            coord_p99_quorum_latency_s=coord.get("p99_quorum_latency_s"),
+            lighthouse_cpu_frac=coord.get("lighthouse_cpu_frac"),
+        )
+
     if ratio is None:
         # fleet phases unusable: fall back to the ws=1 protocol ratio so the
         # bench always reports something honest
@@ -1623,6 +1677,8 @@ def main() -> None:
             out["mean_heal_in_s"] = faults["mean_heal_in_s"]
     if diloco:
         out["diloco"] = diloco
+    if coord:
+        out["coord"] = coord
     if single_tpu:
         out["single_tpu"] = single_tpu
     # FULL detail goes to bench_out.json; stdout gets ONE compact headline
@@ -1666,6 +1722,11 @@ def main() -> None:
         # PR-5 trajectory: outer sync cost, sharded vs replicated
         "sync_overhead_s_sharded": diloco.get("sync_overhead_s_sharded"),
         "sync_overhead_s_replicated": diloco.get("sync_overhead_s_replicated"),
+        # ISSUE-12 coordination plane: quorum latency through churn at
+        # scale, lighthouse CPU, and the aggregation RPC win
+        "coord_p99_quorum_latency_s": coord.get("p99_quorum_latency_s"),
+        "lighthouse_cpu_frac": coord.get("lighthouse_cpu_frac"),
+        "coord_rpc_reduction": coord.get("rpc_reduction_vs_direct"),
         "quant_device_reduce": qdr_active,
         "detail": "bench_out.json",
     }

@@ -332,3 +332,211 @@ class TestLighthouseServer:
             client.close()
         finally:
             server.shutdown()
+
+
+class TestNoteHealth:
+    """Direct unit tests for the heartbeat comm-health fold (previously
+    exercised only indirectly through the gray-failure drills)."""
+
+    def _state_with_reporters(self, now: float, n: int = 3):
+        from torchft_tpu.lighthouse import _State, note_health
+        from torchft_tpu.wire import CommHealth
+
+        state = _State()
+        # n quiet peers establish the fleet median (and the >=3 fresh
+        # reporters floor)
+        for i in range(n):
+            note_health(state, f"peer{i}", CommHealth(), now)
+        return state
+
+    def test_ewma_rises_with_stall_rate(self) -> None:
+        from torchft_tpu.lighthouse import note_health
+        from torchft_tpu.wire import CommHealth
+
+        now = 1000.0
+        state = self._state_with_reporters(now)
+        stalls = 0
+        for beat in range(1, 8):
+            stalls += 100  # 100 stalls/s
+            note_health(state, "gray", CommHealth(stalls=stalls), now + beat)
+        h = state.health["gray"]
+        # alpha = dt/5 per 1 s beat: converges toward 100/s from below
+        assert 50.0 < h.stall_rate <= 100.0
+
+    def test_idle_decay_unflags(self, monkeypatch) -> None:
+        """A flagged straggler whose stalls STOP decays below the flag
+        threshold and un-flags — the natural eviction cooldown."""
+        monkeypatch.setenv("TORCHFT_EVICT_PERSIST", "2")
+        from torchft_tpu.lighthouse import note_health
+        from torchft_tpu.wire import CommHealth
+
+        now = 1000.0
+        state = self._state_with_reporters(now)
+        stalls = 0
+        t = now
+        for _ in range(4):
+            stalls += 200
+            t += 1.0
+            note_health(state, "gray", CommHealth(stalls=stalls), t)
+        assert state.health["gray"].flagged, "straggler never flagged"
+        # stalls stop dead: cumulative counter stays put, the EWMA decays
+        # (rate sample 0 each beat), and the flag clears once the rate
+        # drops under max(ratio*median, min_rate) = 20/s
+        beats = 0
+        while state.health["gray"].flagged and beats < 50:
+            t += 1.0
+            beats += 1
+            note_health(state, "gray", CommHealth(stalls=stalls), t)
+        assert not state.health["gray"].flagged, "idle decay never unflagged"
+        assert state.health["gray"].stall_rate < 20.0
+        assert state.health["gray"].flag_streak == 0
+
+    def test_fewer_than_three_reporters_never_flags(self) -> None:
+        from torchft_tpu.lighthouse import _State, note_health
+        from torchft_tpu.wire import CommHealth
+
+        now = 1000.0
+        state = _State()
+        note_health(state, "quiet", CommHealth(), now)
+        stalls = 0
+        for beat in range(1, 8):
+            stalls += 500
+            note_health(state, "gray", CommHealth(stalls=stalls), now + beat)
+        # two reporters: no majority to say which side is normal
+        assert not state.health["gray"].flagged
+
+
+class TestStragglerEvictCooldownCycle:
+    """The full flag → evict → idle-decay → rejoin cycle against the pure
+    quorum_compute, with TORCHFT_EVICT_SLOW on."""
+
+    def test_cycle(self, monkeypatch) -> None:
+        monkeypatch.setenv("TORCHFT_EVICT_SLOW", "1")
+        monkeypatch.setenv("TORCHFT_EVICT_PERSIST", "2")
+        from torchft_tpu.lighthouse import note_health
+        from torchft_tpu.wire import CommHealth
+
+        cfg = LighthouseConfig(min_replicas=1, join_timeout_ms=0)
+        state = _State()
+        now = 1000.0
+        for rid in ("a", "b", "c", "d"):
+            _join(state, now, _member(rid))
+            note_health(state, rid, CommHealth(), now)
+
+        # phase 1: d's stall rate becomes a persistent outlier → flagged
+        t = now
+        stalls = 0
+        for _ in range(4):
+            t += 1.0
+            stalls += 200
+            for rid in ("a", "b", "c", "d"):
+                state.heartbeats[rid] = t
+                note_health(
+                    state,
+                    rid,
+                    CommHealth(stalls=stalls if rid == "d" else 0),
+                    t,
+                )
+        assert state.health["d"].flagged
+
+        # phase 2: the next quorum evicts d (floor guards hold: 3 >= 1
+        # min_replicas and 3 > 4//2 majority)
+        met, reason = quorum_compute(t, state, cfg)
+        assert met is not None, reason
+        assert [m.replica_id for m in met] == ["a", "b", "c"]
+        assert state.evicted_now == ["d"]
+
+        # phase 3: d idles (cumulative stalls stop moving) → EWMA decays →
+        # un-flagged → the next quorum takes it back (cooldown complete)
+        for _ in range(60):
+            t += 1.0
+            for rid in ("a", "b", "c", "d"):
+                state.heartbeats[rid] = t
+                note_health(
+                    state,
+                    rid,
+                    CommHealth(stalls=stalls if rid == "d" else 0),
+                    t,
+                )
+            # participants re-register each round
+            for rid in ("a", "b", "c", "d"):
+                _join(state, t, _member(rid))
+            if not state.health["d"].flagged:
+                break
+        assert not state.health["d"].flagged, "cooldown never completed"
+        met, reason = quorum_compute(t, state, cfg)
+        assert met is not None, reason
+        assert [m.replica_id for m in met] == ["a", "b", "c", "d"]
+        assert state.evicted_now == []
+
+    def test_eviction_never_breaks_floor(self, monkeypatch) -> None:
+        monkeypatch.setenv("TORCHFT_EVICT_SLOW", "1")
+        cfg = LighthouseConfig(min_replicas=3, join_timeout_ms=0)
+        state = _State()
+        now = 1000.0
+        for rid in ("a", "b", "c"):
+            _join(state, now, _member(rid))
+        from torchft_tpu.lighthouse import _ReplicaHealth
+
+        state.health["c"] = _ReplicaHealth(flagged=True)
+        met, reason = quorum_compute(now, state, cfg)
+        # evicting c would dig below min_replicas: the gray node stays
+        assert met is not None, reason
+        assert len(met) == 3
+        assert state.evicted_now == []
+
+
+class TestStatusSnapshotCache:
+    def test_status_storm_takes_state_lock_once_per_ttl(self, monkeypatch) -> None:
+        """The ISSUE-12 regression gate: a 100-poll status storm acquires
+        the lighthouse state lock at most once per snapshot TTL (plus the
+        boundary), where each poll used to run quorum_compute under the
+        lock."""
+        monkeypatch.setenv("TORCHFT_STATUS_TTL_S", "0.5")
+        server = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1, quorum_tick_ms=10
+        )
+        try:
+            client = LighthouseClient(server.local_address(), connect_timeout=5.0)
+            client.quorum(replica_id="poller", timeout=5.0, step=1)
+            base = server.status_lock_acquires
+            t0 = time.monotonic()
+            for _ in range(100):
+                st = client.status()
+            elapsed = time.monotonic() - t0
+            rebuilds = server.status_lock_acquires - base
+            # one rebuild per elapsed TTL window, plus the leading edge
+            allowed = int(elapsed / 0.5) + 1
+            assert rebuilds <= allowed, (
+                f"{rebuilds} state-lock acquisitions for a 100-poll storm "
+                f"over {elapsed:.2f}s (TTL 0.5s allows {allowed})"
+            )
+            # the snapshot is still a real status payload
+            assert st["quorum_id"] == 1
+            assert st["participants"][0]["replica_id"] == "poller"
+            assert "rpc_counts" in st and "status_rebuilds" in st
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_http_and_wire_share_the_cache(self, monkeypatch) -> None:
+        monkeypatch.setenv("TORCHFT_STATUS_TTL_S", "10.0")
+        server = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1, quorum_tick_ms=10
+        )
+        try:
+            client = LighthouseClient(server.local_address(), connect_timeout=5.0)
+            client.quorum(replica_id="x", timeout=5.0)
+            base = server.status_lock_acquires
+            client.status()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/status.json", timeout=5.0
+            ) as resp:
+                import json
+
+                body = json.loads(resp.read())
+            assert body["participants"][0]["replica_id"] == "x"
+            assert server.status_lock_acquires - base <= 1
+            client.close()
+        finally:
+            server.shutdown()

@@ -853,3 +853,45 @@ def joint_ft_spmd_drill(
         "heal_source_killed": chaos_fired.is_set(),
         "heal_timings": dict(heal_timings),
     }
+
+
+def coord_churn_drill(
+    num_replicas: int = 60,
+    num_aggregators: int = 2,
+    num_spares: int = 2,
+    kills: int = 1,
+    rejoins: int = 1,
+    deadline_s: float = 120.0,
+) -> Dict[str, Any]:
+    """Coordination-plane churn drill: a thin assertion wrapper over the
+    :mod:`torchft_tpu.coord.scale` harness at drill-friendly scale.
+
+    Drives a subprocess lighthouse + zone aggregators + a simulated fleet
+    (with a spare pool and a mixed direct/aggregated membership) through
+    kill/rejoin/promote churn AND an aggregator crash/restart, asserting
+    the coordination-plane invariants the bigger scale runs gate on:
+
+    - zero spurious membership edits (observed ``quorum_id`` bumps equal
+      the churn plan's kills + rejoins — an aggregator bounce contributes
+      none: aggregator death is a reporting gap, not a member death);
+    - every kill with a warm spare registered lands as a promotion;
+    - the aggregated steady state reaches the lighthouse with fewer beat
+      RPCs than the all-direct calibration window.
+    """
+    from torchft_tpu.coord.scale import run_scale_harness
+
+    report = run_scale_harness(
+        num_replicas=num_replicas,
+        num_aggregators=num_aggregators,
+        num_spares=num_spares,
+        kills=kills,
+        rejoins=rejoins,
+        agg_bounce=True,
+        deadline_s=deadline_s,
+    )
+    assert report["spurious_membership_edits"] == 0, report
+    assert report["agg_bounce_edits"] == 0, report
+    assert report["promotions_total"] >= min(kills, num_spares), report
+    reduction = report.get("rpc_reduction_vs_direct")
+    assert reduction is not None and reduction > 1.0, report
+    return report

@@ -73,14 +73,27 @@ _k("TORCHFT_QUORUM_RETRIES", "int", "0",
    "Consecutive failed-quorum retries before the manager raises")
 _k("TORCHFT_CONNECT_RETRIES", "int", "3",
    "Dial attempts with jittered exponential backoff inside the connect deadline")
-_k("TORCHFT_WIRE_COMPAT", "int", "3 (current)",
-   "Pin the MGR_QUORUM_RESP wire version during rolling upgrades (1, 2 or 3)")
+_k("TORCHFT_WIRE_COMPAT", "int", "4 (current)",
+   "Pin the control-plane wire version during rolling upgrades (1..4; 3 disables the v4 coordination plane)")
 _k("TORCHFT_WATCHDOG_TIMEOUT_SEC", "float", "0 (off)",
    "Futures watchdog: log+dump stacks when an op exceeds this bound")
 _k("TORCHFT_TIER", "str", "auto",
    "Control-plane tier: cpp | python | auto (cpp when the native build loads)")
 _k("TORCHFT_NATIVE_DIR", "str", "<repo>/native",
    "Directory holding the native tier build (libtpuft.so)")
+# --- hierarchical coordination plane (wire v4) ------------------------------
+_k("TORCHFT_AGG_ADDR", "str", "unset",
+   "Zone aggregator address (host:port) this manager routes heartbeats through; unset = beat the lighthouse directly")
+_k("TORCHFT_AGG_FLUSH_MS", "float", "100",
+   "Aggregator upstream flush cadence: one batched LH_AGG_BEAT RPC per tick")
+_k("TORCHFT_AGG_TIMEOUT_S", "float", "1.0",
+   "Lighthouse-side flush age after which an aggregator counts dead (reporting gap, not member death)")
+_k("TORCHFT_AGG_GRACE_S", "float", "heartbeat timeout",
+   "Extra member-liveness grace while the member's aggregator is dead (covers the fall-back-to-direct window); explicit 0 disables")
+_k("TORCHFT_AGG_RETRY_S", "float", "2.0",
+   "Member-side cooloff before retrying a failed aggregator (beats go direct meanwhile)")
+_k("TORCHFT_STATUS_TTL_S", "float", "0.5",
+   "Lighthouse /status(.json) snapshot TTL: status polls rebuild (and take the state lock) at most once per TTL")
 # --- observability ----------------------------------------------------------
 _k("TORCHFT_USE_OTEL", "bool", "0",
    "Opt into the OpenTelemetry metrics exporter when the SDK is installed")
@@ -250,6 +263,10 @@ _k("TPUFT_BENCH_SKIP_DILOCO", "bool", "0",
    "Skip the DiLoCo bench phase", "bench")
 _k("TPUFT_BENCH_SKIP_SPARE", "bool", "0",
    "Skip the hot-spare promotion bench phase", "bench")
+_k("TPUFT_BENCH_SKIP_COORD", "bool", "0",
+   "Skip the coordination-plane scale phase", "bench")
+_k("TPUFT_BENCH_COORD_REPLICAS", "int", "120 cpu / 500 tpu",
+   "Simulated replicas driven by the coordination scale phase", "bench")
 _k("TPUFT_BENCH_PROBE_TIMEOUT_S", "float", "180",
    "Backend-executes probe deadline", "bench")
 _k("TPUFT_BENCH_PROBE_WINDOW_S", "float", "900",

@@ -44,19 +44,24 @@ from torchft_tpu.wire import (
     ROLE_ACTIVE,
     ROLE_SPARE,
     WIRE_COMPAT_ENV,
+    AggBeat,
     CommHealth,
     ErrCode,
     MsgType,
     Quorum,
+    QuorumDelta,
     QuorumMember,
     Reader,
     RpcClient,
     WireError,
     Writer,
+    apply_quorum_delta,
     configure_server_socket,
     create_listener,
     connect,
+    make_quorum_delta,
     manager_quorum_wire_version,
+    quorum_digest,
     raise_if_error,
     recv_frame,
     send_error,
@@ -118,6 +123,70 @@ _SPARE_FRESH_FACTOR = 3.0
 
 def _spare_promote_enabled() -> bool:
     return knobs.get_bool(SPARE_PROMOTE_ENV, True)
+
+
+# Hierarchical coordination plane (wire v4).  Zone aggregators batch member
+# heartbeats into one upstream RPC per flush tick (LH_AGG_BEAT_REQ); the
+# lighthouse remembers which aggregator last reported each member.  When an
+# aggregator goes quiet, its members' beat staleness is a REPORTING gap,
+# not evidence of member death: each affected member gets a bounded extra
+# grace window (during which its manager's heartbeat loop falls back to
+# direct beats) before the normal heartbeat verdict applies.  An aggregator
+# is judged dead on a much tighter bound than members (it flushes every
+# ~100 ms), so the gap is known well before any member heartbeat expires.
+AGG_TIMEOUT_S_ENV = "TORCHFT_AGG_TIMEOUT_S"  # default 1.0
+AGG_GRACE_S_ENV = "TORCHFT_AGG_GRACE_S"  # default: heartbeat timeout
+# /status(.json) snapshot TTL: status polls are served from a cached
+# snapshot rebuilt at most once per TTL, so a dashboard fleet polling at
+# high QPS never contends on the quorum state lock.
+STATUS_TTL_S_ENV = "TORCHFT_STATUS_TTL_S"  # default 0.5
+# recently-issued quorums kept for delta-coded broadcasts (by digest)
+_RECENT_QUORUMS_MAX = 8
+_PAYLOAD_CACHE_MAX = 64
+
+
+def _agg_freshness_knobs(hb_timeout_s: float) -> Tuple[float, float]:
+    """(aggregator dead-after age, member grace while its agg is dead).
+
+    Unset grace defaults to one heartbeat timeout; an EXPLICIT 0 disables
+    the reporting-gap excuse entirely (agg-routed members judged as
+    strictly as direct ones) — unset and 0 must stay distinguishable."""
+    agg_timeout = knobs.get_float(AGG_TIMEOUT_S_ENV, 1.0)
+    raw_grace = knobs.get_raw(AGG_GRACE_S_ENV)
+    if raw_grace is None or raw_grace == "":
+        grace = hb_timeout_s
+    else:
+        grace = knobs.get_float(AGG_GRACE_S_ENV, hb_timeout_s)
+    return agg_timeout, grace
+
+
+def _beat_fresh(
+    state: "_State",
+    rid: str,
+    now: float,
+    bound_s: float,
+    agg_timeout_s: float,
+    grace_s: float,
+) -> bool:
+    """Member liveness with the aggregator reporting-gap excuse: fresh
+    within ``bound_s`` as before; a member whose last beat arrived via an
+    aggregator that is itself dead gets ``grace_s`` extra (its beats
+    stopped because the REPORTER died — the member's manager falls back to
+    direct beats within a heartbeat interval or two).  A stale member
+    whose aggregator is alive is genuinely quiet and gets no excuse."""
+    ts = state.heartbeats.get(rid)
+    if ts is None:
+        return False
+    age = now - ts
+    if age < bound_s:
+        return True
+    agg = state.via_agg.get(rid)
+    if agg is None or grace_s <= 0:
+        return False
+    agg_ts = state.agg_last.get(agg)
+    if agg_ts is not None and now - agg_ts <= agg_timeout_s:
+        return False  # reporting path alive: the member itself went quiet
+    return age < bound_s + grace_s
 
 
 def _spare_max_lag() -> Optional[int]:
@@ -207,6 +276,15 @@ class _State:
     # the member still counts healthy and permanently missing the
     # promotion once the shrunk quorum becomes prev)
     hold_since: Dict[str, float] = field(default_factory=dict)
+    # hierarchical coordination plane (wire v4): which aggregator last
+    # reported each member (cleared when the member beats direct), and
+    # each aggregator's last flush time — the inputs to the aggregator
+    # reporting-gap grace in ``_beat_fresh``
+    via_agg: Dict[str, str] = field(default_factory=dict)
+    agg_last: Dict[str, float] = field(default_factory=dict)
+    # rate limit for the note_health stale-entry prune (an O(members)
+    # sweep per beat would be O(N^2)/s at fleet scale)
+    health_pruned_ts: float = 0.0
 
 
 # health entries stop counting as straggler-median "reporters" after this
@@ -221,12 +299,14 @@ def note_health(state: _State, replica_id: str, health: CommHealth, now: float) 
     """Fold one heartbeat's cumulative comm-health counters into the
     replica's EWMA rates, then re-evaluate the outlier flags.  Pure on
     ``state`` (caller holds the server lock); driven directly by tests."""
-    for rid in [
-        r
-        for r, rh in state.health.items()
-        if now - rh.last_ts > 4 * _HEALTH_STALE_S
-    ]:
-        del state.health[rid]
+    if now - state.health_pruned_ts > _HEALTH_STALE_S or now < state.health_pruned_ts:
+        state.health_pruned_ts = now
+        for rid in [
+            r
+            for r, rh in state.health.items()
+            if now - rh.last_ts > 4 * _HEALTH_STALE_S
+        ]:
+            del state.health[rid]
     h = state.health.setdefault(replica_id, _ReplicaHealth())
     if h.last is not None and now > h.last_ts:
         dt = now - h.last_ts
@@ -276,6 +356,25 @@ def _evaluate_stragglers(state: _State, updated_id: str, now: float) -> None:
         )
 
 
+def _note_warm_step(state: "_State", replica_id: str, warm_step: int) -> None:
+    """Fold a beat-carried spare warm watermark into the registration
+    record (wire v4): promotion eligibility and the /status spare table
+    stay fresh at heartbeat cadence instead of quorum-RPC re-registration
+    cadence.  Monotonic — a scheduler-starved stale beat never regresses
+    the watermark.  Caller holds the server lock.
+
+    COPY-on-write, never in place: the registered member object is shared
+    by reference with every issued quorum that carried it (prev_quorum and
+    the delta-base ring), whose digests were stamped at issue time — an
+    in-place step bump would silently drift their content out from under
+    those digests and break every delta computed against them."""
+    details = state.spares.get(replica_id)
+    if details is not None and warm_step > details.member.step:
+        import dataclasses
+
+        details.member = dataclasses.replace(details.member, step=warm_step)
+
+
 def _promote_spares(
     now: float, state: _State, cfg: LighthouseConfig, healthy_replicas: set
 ) -> None:
@@ -293,6 +392,7 @@ def _promote_spares(
         # caller asked to only ever shrink
         return
     hb_timeout_s = cfg.heartbeat_timeout_ms / 1000.0
+    agg_timeout_s, grace_s = _agg_freshness_knobs(hb_timeout_s)
     prev = state.prev_quorum.participants
     prev_ids = {m.replica_id for m in prev}
     dead_prev = {
@@ -315,8 +415,14 @@ def _promote_spares(
     eligible = [
         d
         for rid, d in state.spares.items()
-        if now - state.heartbeats.get(rid, float("-inf"))
-        < _SPARE_FRESH_FACTOR * hb_timeout_s
+        if _beat_fresh(
+            state,
+            rid,
+            now,
+            _SPARE_FRESH_FACTOR * hb_timeout_s,
+            agg_timeout_s,
+            grace_s,
+        )
     ]
     max_lag = _spare_max_lag()
     if max_lag is not None:
@@ -360,10 +466,12 @@ def quorum_compute(
     status read stays side-effect free.
     """
     hb_timeout_s = cfg.heartbeat_timeout_ms / 1000.0
+    agg_timeout_s, grace_s = _agg_freshness_knobs(hb_timeout_s)
     healthy_replicas = {
         rid
-        for rid, ts in state.heartbeats.items()
-        if now - ts < hb_timeout_s and rid not in state.spare_ids
+        for rid in state.heartbeats
+        if rid not in state.spare_ids
+        and _beat_fresh(state, rid, now, hb_timeout_s, agg_timeout_s, grace_s)
     }
     if allow_promote:
         _promote_spares(now, state, cfg, healthy_replicas)
@@ -486,8 +594,14 @@ def quorum_compute(
         # same laxer liveness bound promotion eligibility uses: the hold
         # must never wait for a verdict the promotion would then refuse
         spare_fresh = any(
-            now - state.heartbeats.get(rid, float("-inf"))
-            < _SPARE_FRESH_FACTOR * hb_timeout_s
+            _beat_fresh(
+                state,
+                rid,
+                now,
+                _SPARE_FRESH_FACTOR * hb_timeout_s,
+                agg_timeout_s,
+                grace_s,
+            )
             for rid in state.spares
         )
         hold_window_s = (
@@ -545,6 +659,33 @@ class LighthouseServer:
         self._generation = 0  # bumped on every broadcast quorum
         self._change_reason: Optional[str] = None
         self._shutdown = False
+        # rate limit for the proactive tick quorum requests run: at fleet
+        # scale a registration storm would otherwise run one O(members)
+        # quorum_compute PER request (O(N^2) per round); the background
+        # tick loop bounds the added latency to one tick interval
+        self._last_tick_ts = 0.0
+        # delta-coded broadcasts (wire v4): recently issued quorums by
+        # content digest (the delta bases requesters may advertise) and a
+        # small cache of encoded response payloads — one delta/full build
+        # per (base, new) pair per round instead of one per parked waiter
+        self._recent_quorums: Dict[int, Quorum] = {}
+        self._payload_cache: Dict[tuple, tuple] = {}
+        self._payload_lock = threading.Lock()
+        # cached /status snapshot: (built_ts, snapshot dict, json bytes);
+        # rebuilt at most once per TORCHFT_STATUS_TTL_S so status polls
+        # never contend on the quorum state lock.  status_lock_acquires
+        # counts actual rebuilds (the regression gate for status storms).
+        self._status_cache: Tuple[float, Optional[dict], bytes] = (
+            float("-inf"),
+            None,
+            b"",
+        )
+        self._status_cache_lock = threading.Lock()
+        self.status_lock_acquires = 0
+        # inbound RPC counters by MsgType (the aggregation win is measured
+        # here: agg flushes replace per-member heartbeat RPCs)
+        self._inbound_counts: Dict[int, int] = {}
+        self._inbound_counts_lock = threading.Lock()
         # parked quorum waiters (token → member), re-registered atomically
         # when a quorum excludes them — see _tick_locked
         self._parked: Dict[object, QuorumMember] = {}
@@ -610,6 +751,7 @@ class LighthouseServer:
 
     def _tick_locked(self) -> None:
         """One quorum decision round (``src/lighthouse.rs:292-343``)."""
+        self._last_tick_ts = time.monotonic()
         participants, reason = quorum_compute(time.monotonic(), self._state, self._cfg)
         self._log_if_changed(reason)
         if participants is None:
@@ -645,6 +787,7 @@ class LighthouseServer:
             )
 
         hb_timeout_s = self._cfg.heartbeat_timeout_ms / 1000.0
+        agg_timeout_s, grace_s = _agg_freshness_knobs(hb_timeout_s)
         now = time.monotonic()
         quorum = Quorum(
             quorum_id=state.quorum_id,
@@ -657,8 +800,14 @@ class LighthouseServer:
                 (
                     d.member
                     for rid, d in state.spares.items()
-                    if now - state.heartbeats.get(rid, float("-inf"))
-                    < _SPARE_FRESH_FACTOR * hb_timeout_s
+                    if _beat_fresh(
+                        state,
+                        rid,
+                        now,
+                        _SPARE_FRESH_FACTOR * hb_timeout_s,
+                        agg_timeout_s,
+                        grace_s,
+                    )
                 ),
                 key=lambda m: m.replica_id,
             ),
@@ -666,6 +815,13 @@ class LighthouseServer:
         state.prev_quorum = quorum
         state.participants.clear()
         state.hold_since.clear()  # fresh prev quorum, fresh hold anchors
+        # delta-base ring: waiters advertising this quorum's digest on
+        # later rounds receive membership deltas instead of full snapshots
+        digest = quorum_digest(quorum)
+        quorum._digest = digest
+        self._recent_quorums[digest] = quorum
+        while len(self._recent_quorums) > _RECENT_QUORUMS_MAX:
+            self._recent_quorums.pop(next(iter(self._recent_quorums)))
         # spare registrations are STICKY (unlike participants): a spare
         # spends most of its time warming, not parked on a quorum RPC, and
         # promotion must find it registered the instant an active dies.
@@ -734,6 +890,10 @@ class LighthouseServer:
                 return
             while True:
                 msg_type, r = recv_frame(conn)
+                with self._inbound_counts_lock:
+                    self._inbound_counts[msg_type] = (
+                        self._inbound_counts.get(msg_type, 0) + 1
+                    )
                 if msg_type == MsgType.LH_QUORUM_REQ:
                     self._handle_quorum(conn, r)
                 elif msg_type == MsgType.LH_HEARTBEAT_REQ:
@@ -743,17 +903,52 @@ class LighthouseServer:
                     health = None
                     if not r.done() and r.u8():
                         health = CommHealth.decode(r)
+                    # optional v4 spare warm-step tail (flag byte + i64)
+                    warm_step = None
+                    if not r.done() and r.u8():
+                        warm_step = r.i64()
                     with self._lock:
                         now = time.monotonic()
-                        self._state.heartbeats[replica_id] = now
+                        state = self._state
+                        state.heartbeats[replica_id] = now
+                        # a direct beat resets the reporting path: this
+                        # member's liveness is judged without agg grace
+                        state.via_agg.pop(replica_id, None)
                         if health is not None:
-                            note_health(self._state, replica_id, health, now)
+                            note_health(state, replica_id, health, now)
+                        if warm_step is not None:
+                            _note_warm_step(state, replica_id, warm_step)
                     send_frame(conn, MsgType.LH_HEARTBEAT_RESP)
+                elif msg_type == MsgType.LH_AGG_BEAT_REQ:
+                    # one aggregator flush: every member beat it batched
+                    # since the last flush lands under ONE lock acquisition
+                    agg = AggBeat.decode(r)
+                    with self._lock:
+                        now = time.monotonic()
+                        state = self._state
+                        state.agg_last[agg.agg_id] = now
+                        for beat in agg.beats:
+                            state.heartbeats[beat.replica_id] = now
+                            state.via_agg[beat.replica_id] = agg.agg_id
+                            if beat.health is not None:
+                                note_health(
+                                    state, beat.replica_id, beat.health, now
+                                )
+                            if beat.role == ROLE_SPARE and beat.warm_step >= 0:
+                                _note_warm_step(
+                                    state, beat.replica_id, beat.warm_step
+                                )
+                    send_frame(conn, MsgType.LH_AGG_BEAT_RESP)
                 elif msg_type == MsgType.LH_STATUS_REQ:
+                    # serve the CACHED pre-serialized snapshot: blob() is
+                    # wire-identical to string() (u32 length + utf-8
+                    # bytes), so the client's r.string() reads it while
+                    # this path pays zero per-poll json.dumps — the same
+                    # O(members) cost the TTL cache amortizes for HTTP
                     send_frame(
                         conn,
                         MsgType.LH_STATUS_RESP,
-                        Writer().string(json.dumps(self._status())).payload(),
+                        Writer().blob(self._status_json()).payload(),
                     )
                 else:
                     send_error(conn, ErrCode.INVALID, f"bad lighthouse op {msg_type}")
@@ -791,9 +986,17 @@ class LighthouseServer:
     def _handle_quorum(self, conn: socket.socket, r: Reader) -> None:
         requester = QuorumMember.decode(r)
         timeout_ms = r.u64()
-        # v3 role tail (absent on legacy clients and active members)
-        if not r.done() and r.u32() >= 3:
-            requester.role = r.u8()
+        # v3 role tail (absent on legacy clients); v4 adds the delta base:
+        # the digest of the last quorum this requester decoded, so the
+        # response can be a membership delta instead of the full list
+        base_digest: Optional[int] = None
+        if not r.done():
+            tail_version = r.u32()
+            if tail_version >= 3:
+                requester.role = r.u8()
+            if tail_version >= 4 and r.boolean():
+                r.i64()  # base quorum_id (diagnostic only)
+                base_digest = r.u64()
         deadline = time.monotonic() + timeout_ms / 1000.0
         logger.info("Received quorum request for replica %s", requester.replica_id)
 
@@ -829,9 +1032,7 @@ class LighthouseServer:
         if promoted_fast:
             conn.settimeout(30.0)
             try:
-                w = Writer()
-                quorum.encode(w)
-                send_frame(conn, MsgType.LH_QUORUM_RESP, w.payload())
+                self._send_quorum_resp(conn, quorum, base_digest)
             finally:
                 conn.settimeout(None)
             return
@@ -839,7 +1040,15 @@ class LighthouseServer:
             self._parked[token] = requester
             gen = self._generation
             try:
-                self._tick_locked()  # proactive tick
+                # proactive tick, rate-limited: a fleet-scale registration
+                # storm must not run one O(members) quorum_compute per
+                # request — the background tick loop (and the requests that
+                # do win the rate gate) bound added latency to ~one tick
+                if (
+                    time.monotonic() - self._last_tick_ts
+                    >= 0.5 * self._cfg.quorum_tick_ms / 1000.0
+                ):
+                    self._tick_locked()
                 while True:
                     if self._generation > gen:
                         gen = self._generation
@@ -878,16 +1087,89 @@ class LighthouseServer:
             if failure is not None:
                 send_error(conn, failure[0], failure[1])
                 return
-            w = Writer()
-            quorum.encode(w)
-            send_frame(conn, MsgType.LH_QUORUM_RESP, w.payload())
+            self._send_quorum_resp(conn, quorum, base_digest)
         finally:
             conn.settimeout(None)
+
+    def _quorum_payload(
+        self, quorum: Quorum, base_digest: Optional[int]
+    ) -> Tuple[int, bytes]:
+        """(msg_type, payload) answering one quorum request: a membership
+        delta when the requester advertised a base this server still holds
+        (and the pin allows v4), else the full snapshot.  Encoded payloads
+        are cached per (base, new, version) so a thousand parked waiters
+        cost one encode, not a thousand."""
+        wire_version = manager_quorum_wire_version()
+        new_digest = getattr(quorum, "_digest", None)
+        if new_digest is None:
+            new_digest = quorum_digest(quorum)
+        # quorum_id/created ride the payload but not the digest (a
+        # commit-failure round bumps quorum_id with identical membership),
+        # so they must be part of the cache key
+        issue = (quorum.quorum_id, quorum.created)
+        if base_digest is not None and wire_version >= 4:
+            base = self._recent_quorums.get(base_digest)
+            if base is not None:
+                key = ("delta", wire_version, base_digest, new_digest, issue)
+                with self._payload_lock:
+                    hit = self._payload_cache.get(key)
+                if hit is not None:
+                    return hit
+                w = Writer()
+                make_quorum_delta(base, quorum).encode(w)
+                resp = (int(MsgType.LH_QUORUM_DELTA_RESP), w.payload())
+                with self._payload_lock:
+                    if len(self._payload_cache) > _PAYLOAD_CACHE_MAX:
+                        self._payload_cache.clear()
+                    self._payload_cache[key] = resp
+                return resp
+        key = ("full", wire_version, new_digest, issue)
+        with self._payload_lock:
+            hit = self._payload_cache.get(key)
+        if hit is not None:
+            return hit
+        w = Writer()
+        quorum.encode(w)
+        resp = (int(MsgType.LH_QUORUM_RESP), w.payload())
+        with self._payload_lock:
+            if len(self._payload_cache) > _PAYLOAD_CACHE_MAX:
+                self._payload_cache.clear()
+            self._payload_cache[key] = resp
+        return resp
+
+    def _send_quorum_resp(
+        self, conn: socket.socket, quorum: Quorum, base_digest: Optional[int]
+    ) -> None:
+        msg_type, payload = self._quorum_payload(quorum, base_digest)
+        send_frame(conn, msg_type, payload)
 
     # -- status / dashboard -------------------------------------------------
 
     def _status(self) -> dict:
+        return self._status_snapshot()[0]
+
+    def _status_json(self) -> bytes:
+        return self._status_snapshot()[1]
+
+    def _status_snapshot(self) -> Tuple[dict, bytes]:
+        """Serve status from the TTL-cached snapshot: a status storm (the
+        dashboard fleet) acquires the quorum state lock at most once per
+        ``TORCHFT_STATUS_TTL_S``, and concurrent polls serialize on the
+        cache lock, not the quorum loop."""
+        ttl = knobs.get_float(STATUS_TTL_S_ENV, 0.5)
+        now = time.monotonic()
+        with self._status_cache_lock:
+            built_ts, snap, raw = self._status_cache
+            if snap is not None and now - built_ts < ttl:
+                return snap, raw
+            snap = self._status_rebuild()
+            raw = json.dumps(snap, indent=2).encode()
+            self._status_cache = (now, snap, raw)
+            return snap, raw
+
+    def _status_rebuild(self) -> dict:
         with self._lock:
+            self.status_lock_acquires += 1
             now = time.monotonic()
             # quorum_compute writes state.evicted_now (the tick loop's
             # eviction-accounting channel); a status read must stay
@@ -910,6 +1192,10 @@ class LighthouseServer:
                 if p.step < max_step
             ]
             return {
+                # the rebuild's own clock: rate math over cached snapshots
+                # must difference counters against THIS, not the caller's
+                # poll time (a cached snapshot is up to one TTL stale)
+                "now_monotonic": round(now, 3),
                 "quorum_id": self._state.quorum_id,
                 "quorum_status": reason,
                 "max_step": max_step,
@@ -973,7 +1259,29 @@ class LighthouseServer:
                     for _rid, d in sorted(self._state.spares.items())
                 ],
                 "promotions_total": self._state.promotions_total,
+                # hierarchical coordination plane: aggregator flush ages +
+                # which members currently report via an aggregator, and the
+                # inbound RPC counters the aggregation win is measured by
+                "aggregators": {
+                    agg_id: round(now - ts, 2)
+                    for agg_id, ts in sorted(self._state.agg_last.items())
+                },
+                "aggregated_members": len(self._state.via_agg),
+                "rpc_counts": self._inbound_counts_by_name(),
+                "status_rebuilds": self.status_lock_acquires,
             }
+
+    def _inbound_counts_by_name(self) -> Dict[str, int]:
+        with self._inbound_counts_lock:
+            counts = dict(self._inbound_counts)
+        out: Dict[str, int] = {}
+        for mt, n in sorted(counts.items()):
+            try:
+                name = MsgType(mt).name
+            except ValueError:
+                name = f"0x{mt:x}"
+            out[name] = n
+        return out
 
     def _handle_http(self, conn: socket.socket) -> None:
         """Minimal dashboard (``templates/status.html`` analog)."""
@@ -995,7 +1303,7 @@ class LighthouseServer:
             status = "200 OK" if ok else "404 Not Found"
             ctype = "application/json"
         elif path == "/status.json":
-            body = json.dumps(self._status(), indent=2).encode()
+            body = self._status_json()
             status, ctype = "200 OK", "application/json"
         else:
             body = self._render_status_html().encode()
@@ -1096,10 +1404,24 @@ class LighthouseServer:
 
 
 class LighthouseClient(RpcClient):
-    """Client for :class:`LighthouseServer` (pyo3 analog ``src/lib.rs:486-594``)."""
+    """Client for :class:`LighthouseServer` (pyo3 analog ``src/lib.rs:486-594``).
+
+    Under wire v4 the client caches the last quorum it decoded and
+    advertises its digest on every request; the server answers with a
+    membership delta (``LH_QUORUM_DELTA_RESP``) when it still holds that
+    base, and with the full snapshot otherwise — so steady-state broadcast
+    bytes are O(changes), not O(members).  ``delta_responses`` /
+    ``full_responses`` count which path each round took (harness +
+    observability input)."""
 
     def __init__(self, addr: str, connect_timeout: float = 60.0) -> None:
         super().__init__(addr, connect_timeout=connect_timeout)
+        # delta-coded broadcast cache: mutated only inside quorum(), which
+        # callers serialize like every other rpc on this client
+        self._quorum_cache: Optional[Quorum] = None
+        self._quorum_cache_digest = 0
+        self.delta_responses = 0
+        self.full_responses = 0
 
     def quorum(
         self,
@@ -1135,36 +1457,75 @@ class LighthouseClient(RpcClient):
         w = Writer()
         member.encode(w)
         w.u64(int(timeout * 1000))
-        if role != ROLE_ACTIVE:
-            if manager_quorum_wire_version() < 3:
-                # never degrade silently: dropping the role tail would
-                # register this spare as a full ACTIVE (counted toward
-                # min_replicas/majority) on the lighthouse
-                raise ValueError(
-                    f"role={role} requires quorum wire v3 "
-                    f"({WIRE_COMPAT_ENV} pins an older version)"
-                )
-            # version-gated tail: active members stay byte-identical to v2
-            # (a legacy or native-tier lighthouse never sees spare frames)
+        wire_version = manager_quorum_wire_version()
+        if role != ROLE_ACTIVE and wire_version < 3:
+            # never degrade silently: dropping the role tail would
+            # register this spare as a full ACTIVE (counted toward
+            # min_replicas/majority) on the lighthouse
+            raise ValueError(
+                f"role={role} requires quorum wire v3 "
+                f"({WIRE_COMPAT_ENV} pins an older version)"
+            )
+        base = self._quorum_cache if wire_version >= 4 else None
+        if wire_version >= 4:
+            # v4 tail: role + the delta base this client can apply edits
+            # to.  A v3 (or older) server reads the role and ignores the
+            # rest; it can only ever answer with a full snapshot.
+            w.u32(4)
+            w.u8(role)
+            w.boolean(base is not None)
+            if base is not None:
+                w.i64(base.quorum_id)
+                w.u64(self._quorum_cache_digest)
+        elif role != ROLE_ACTIVE:
+            # version-gated v3 tail: active members stay byte-identical to
+            # v2 (a legacy or native-tier lighthouse never sees spare
+            # frames)
             w.u32(3)
             w.u8(role)
         msg_type, r = self.call(MsgType.LH_QUORUM_REQ, w.payload(), timeout)
         raise_if_error(msg_type, r)
-        return Quorum.decode(r)
+        if msg_type == MsgType.LH_QUORUM_DELTA_RESP:
+            delta = QuorumDelta.decode(r)
+            try:
+                quorum = apply_quorum_delta(
+                    base, delta, base_digest=self._quorum_cache_digest
+                )
+            except WireError:
+                # divergent base: clear the cache so the retry advertises
+                # no base and receives a full snapshot
+                self._quorum_cache = None
+                raise
+            self.delta_responses += 1
+        else:
+            quorum = Quorum.decode(r)
+            self.full_responses += 1
+        if wire_version >= 4:
+            self._quorum_cache = quorum
+            self._quorum_cache_digest = quorum_digest(quorum)
+        return quorum
 
     def heartbeat(
         self,
         replica_id: str,
         timeout: float = 5.0,
         health: Optional[CommHealth] = None,
+        warm_step: Optional[int] = None,
     ) -> None:
         """Heartbeat, optionally carrying a cumulative comm-health summary
-        (straggler detection input).  Idempotent: one reconnect-retry rides
-        out a lighthouse connection blip instead of crashing the sender."""
+        (straggler detection input) and, under wire v4, a spare warm-step
+        watermark (keeps the lighthouse's promotion-eligibility view fresh
+        at beat cadence).  Idempotent: one reconnect-retry rides out a
+        lighthouse connection blip instead of crashing the sender."""
         w = Writer().string(replica_id)
-        if health is not None:
+        send_warm = warm_step is not None and manager_quorum_wire_version() >= 4
+        if health is not None or send_warm:
+            w.u8(1 if health is not None else 0)
+            if health is not None:
+                health.encode(w)
+        if send_warm:
             w.u8(1)
-            health.encode(w)
+            w.i64(warm_step)
         msg_type, r = self.call(
             MsgType.LH_HEARTBEAT_REQ, w.payload(), timeout, idempotent=True
         )

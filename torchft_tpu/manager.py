@@ -336,6 +336,12 @@ class Manager:
                 health_fn=self._comm_health,
                 role=ROLE_SPARE if role == "spare" else ROLE_ACTIVE,
                 warm_fn=self._warm_snapshot,
+                # spares ride their warm watermark on every beat (wire v4)
+                # so promotion eligibility stays fresh without a quorum-RPC
+                # re-registration; actives report nothing
+                warm_step_fn=(
+                    (lambda: self._step) if role == "spare" else None
+                ),
             )
             # idle-priority warm serving: spare chunk fetches yield to live
             # collectives when the communicator exposes a busy probe
@@ -717,6 +723,13 @@ class Manager:
                 # re-reports a stale overlap_ratio
                 quorum_extra.update(self._outer_shard_stats)
                 self._outer_shard_stats = {}
+            coord_stats_fn = getattr(
+                self._manager_server, "coord_stats", None
+            )
+            if callable(coord_stats_fn):
+                # coordination-plane beat routing of this replica (via-agg
+                # vs direct vs fallbacks) rides the same event
+                quorum_extra.update(coord_stats_fn())
             lane_stats_fn = getattr(self._comm, "lane_stats", None)
             prev_lane_stats = lane_stats_fn() if callable(lane_stats_fn) else {}
             if prev_lane_stats:

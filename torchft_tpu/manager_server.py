@@ -230,6 +230,7 @@ class ManagerServer:
         health_fn: Optional[Callable[[], Optional[object]]] = None,
         role: int = ROLE_ACTIVE,
         warm_fn: Optional[Callable[[], Optional[object]]] = None,
+        warm_step_fn: Optional[Callable[[], int]] = None,
     ) -> None:
         self._replica_id = replica_id
         self._lighthouse_addr = lighthouse_addr
@@ -254,6 +255,18 @@ class ManagerServer:
         # MGR_WARM_INDEX/MGR_WARM_RANGE entirely OUTSIDE the heal path so a
         # warming spare can never clobber (or block on) a real recovery.
         self._warm_fn = warm_fn
+        # spare warm watermark provider (wire v4): rides every heartbeat so
+        # the lighthouse's promotion-eligibility view stays fresh at beat
+        # cadence, not quorum-RPC re-registration cadence
+        self._warm_step_fn = warm_step_fn
+        # hierarchical coordination plane: beats route through the zone
+        # aggregator named by TORCHFT_AGG_ADDR (read live each beat) and
+        # fall back to direct lighthouse beats on aggregator death.
+        # Counters are single-writer (the heartbeat thread); readers
+        # (coord_stats) tolerate a stale snapshot.
+        self._beats_via_agg = 0
+        self._beats_direct = 0
+        self._agg_fallbacks = 0
         # foreground-busy probe (idle-priority warm serving): when set and
         # True, warm-range responses briefly yield so spare traffic never
         # contends with a live collective on the NIC
@@ -346,8 +359,19 @@ class ManagerServer:
     # -- background loops ---------------------------------------------------
 
     def _run_heartbeat(self) -> None:
-        """Heartbeat the lighthouse until shutdown (``src/manager.rs:194-216``)."""
+        """Heartbeat until shutdown (``src/manager.rs:194-216``), routed
+        through the zone aggregator when one is configured
+        (``TORCHFT_AGG_ADDR``, wire v4) and falling back to direct
+        lighthouse beats whenever the aggregator is unreachable —
+        aggregator death costs one beat interval of reporting, never
+        membership.  Lighthouse-restart detection works on both paths: the
+        direct path sees beat-success-after-failure itself; the aggregated
+        path learns it from the restart counter every AGG_BEAT_RESP
+        carries."""
         client: Optional[LighthouseClient] = None
+        agg_client = None
+        agg_down_until = 0.0
+        agg_lh_restarts: Optional[int] = None
         beat_failures = 0
         while not self._shutdown:
             if self.heartbeat_paused:
@@ -359,38 +383,131 @@ class ManagerServer:
                     health = self._health_fn()
                 except Exception:  # noqa: BLE001 — probe must not kill beats
                     health = None
-            try:
-                if client is None:
-                    client = LighthouseClient(
-                        self._lighthouse_addr, connect_timeout=self._connect_timeout
+            warm_step = -1
+            if self._warm_step_fn is not None:
+                try:
+                    warm_step = int(self._warm_step_fn())
+                except Exception:  # noqa: BLE001 — probe must not kill beats
+                    warm_step = -1
+            sent = False
+            from torchft_tpu.wire import manager_quorum_wire_version
+
+            agg_addr = knobs.get_str("TORCHFT_AGG_ADDR", "")
+            if (
+                agg_addr
+                and manager_quorum_wire_version() >= 4
+                and time.monotonic() >= agg_down_until
+            ):
+                try:
+                    if agg_client is None or agg_client.addr != agg_addr:
+                        if agg_client is not None:
+                            agg_client.close()
+                        from torchft_tpu.coord.aggregator import AggMemberClient
+
+                        agg_client = AggMemberClient(
+                            agg_addr, connect_timeout=self._connect_timeout
+                        )
+                    resp = agg_client.beat(
+                        self._replica_id,
+                        role=self.role,
+                        warm_step=warm_step,
+                        health=health,
                     )
-                client.heartbeat(self._replica_id, health=health)
-                if beat_failures:
-                    # the lighthouse answered after failing: it (likely)
-                    # restarted with empty soft state.  A quorum RPC parked
-                    # against the DEAD incarnation would wedge until its
-                    # timeout; interrupt it so it re-registers (idempotent)
-                    # against the fresh lighthouse immediately.
-                    beat_failures = 0
-                    # single-writer counter: only this heartbeat thread ever
-                    # increments; readers tolerate a stale generation (they
-                    # re-check next round)
+                    sent = True
                     # ftlint: ignore[thread-safety] — single-writer counter
-                    self._lh_restart_gen += 1
-                    self._interrupt_lh_quorum()
-            except (OSError, TimeoutError, WireError) as e:
-                beat_failures += 1
-                logger.info(
-                    "[Replica %s] failed to send heartbeat to lighthouse: %s",
-                    self._replica_id,
-                    e,
-                )
-                if client is not None:
-                    client.close()
-                client = None
+                    self._beats_via_agg += 1
+                    restarts = int(resp["lh_restarts"])  # type: ignore[arg-type]
+                    restart_seen = (
+                        agg_lh_restarts is not None
+                        and restarts > agg_lh_restarts
+                    )
+                    agg_lh_restarts = restarts
+                    if not resp["upstream_ok"]:
+                        # the aggregator itself can't reach the lighthouse
+                        # (asymmetric partition: we can reach both, it can
+                        # reach neither of its flushes through).  A beat
+                        # parked in a dead-ended aggregator is NOT a beat —
+                        # fall through to a DIRECT one this round, or the
+                        # whole zone ages out together when the grace
+                        # window expires.  The direct branch tracks its own
+                        # failures, so restart detection (and the parked-
+                        # quorum interrupt) follows whichever path actually
+                        # reaches the lighthouse.
+                        sent = False
+                    elif beat_failures or restart_seen:
+                        beat_failures = 0
+                        # ftlint: ignore[thread-safety] — single-writer counter
+                        self._lh_restart_gen += 1
+                        self._interrupt_lh_quorum()
+                except (OSError, TimeoutError, WireError) as e:
+                    logger.info(
+                        "[Replica %s] aggregator %s unreachable, falling "
+                        "back to direct beats: %s",
+                        self._replica_id,
+                        agg_addr,
+                        e,
+                    )
+                    if agg_client is not None:
+                        agg_client.close()
+                    agg_client = None
+                    agg_lh_restarts = None
+                    agg_down_until = time.monotonic() + knobs.get_float(
+                        "TORCHFT_AGG_RETRY_S", 2.0
+                    )
+                    # ftlint: ignore[thread-safety] — single-writer counter
+                    self._agg_fallbacks += 1
+            if not sent:
+                try:
+                    if client is None:
+                        client = LighthouseClient(
+                            self._lighthouse_addr,
+                            connect_timeout=self._connect_timeout,
+                        )
+                    client.heartbeat(
+                        self._replica_id,
+                        health=health,
+                        warm_step=warm_step if warm_step >= 0 else None,
+                    )
+                    # ftlint: ignore[thread-safety] — single-writer counter
+                    self._beats_direct += 1
+                    if beat_failures:
+                        # the lighthouse answered after failing: it (likely)
+                        # restarted with empty soft state.  A quorum RPC
+                        # parked against the DEAD incarnation would wedge
+                        # until its timeout; interrupt it so it re-registers
+                        # (idempotent) against the fresh lighthouse
+                        # immediately.
+                        beat_failures = 0
+                        # single-writer counter: only this heartbeat thread
+                        # ever increments; readers tolerate a stale
+                        # generation (they re-check next round)
+                        # ftlint: ignore[thread-safety] — single-writer counter
+                        self._lh_restart_gen += 1
+                        self._interrupt_lh_quorum()
+                except (OSError, TimeoutError, WireError) as e:
+                    beat_failures += 1
+                    logger.info(
+                        "[Replica %s] failed to send heartbeat to lighthouse: %s",
+                        self._replica_id,
+                        e,
+                    )
+                    if client is not None:
+                        client.close()
+                    client = None
             time.sleep(self._heartbeat_interval)
         if client is not None:
             client.close()
+        if agg_client is not None:
+            agg_client.close()
+
+    def coord_stats(self) -> Dict[str, int]:
+        """Coordination-plane beat routing counters (observability: the
+        manager folds them into the ``torchft_quorums`` extras)."""
+        return {
+            "coord_beats_via_agg": self._beats_via_agg,
+            "coord_beats_direct": self._beats_direct,
+            "coord_agg_fallbacks": self._agg_fallbacks,
+        }
 
     def _interrupt_lh_quorum(self) -> None:
         """Sever the persistent quorum-forwarding connection WITHOUT taking

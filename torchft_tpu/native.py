@@ -485,6 +485,7 @@ class CppManagerServer:
         health_fn: Optional[object] = None,
         role: int = 0,
         warm_fn: Optional[object] = None,
+        warm_step_fn: Optional[object] = None,
     ) -> None:
         import socket
 
@@ -492,10 +493,11 @@ class CppManagerServer:
         # detection) is accepted for construction parity with the Python
         # ManagerServer but unused: the C++ sidecar sends legacy
         # heartbeats, which the lighthouse treats as "no health report".
-        # warm_fn (spare warm-snapshot serving) likewise: the C++ sidecar
+        # warm_fn (spare warm-snapshot serving) and warm_step_fn (the
+        # beat-carried spare warm watermark) likewise: the C++ sidecar
         # cannot host a spare or feed one — spare roles require the Python
         # tier (Manager(role="spare") refuses a native server_cls).
-        del health_fn, warm_fn
+        del health_fn, warm_fn, warm_step_fn
         if role != 0:
             raise ValueError(
                 "CppManagerServer does not support the SPARE role; use the "

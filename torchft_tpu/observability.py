@@ -75,6 +75,12 @@ _ATTR_KEYS = (
     "outer_shard_gather_s",
     "outer_shard_wall_s",
     "outer_shard_overlap_ratio",
+    # coordination-plane counters (torchft_quorums; how this replica's
+    # heartbeats routed — zone aggregator vs direct lighthouse — and how
+    # often it fell back on aggregator death)
+    "coord_beats_via_agg",
+    "coord_beats_direct",
+    "coord_agg_fallbacks",
     # heal-path counters (torchft_heals; striped checkpoint recovery)
     "heal_bytes",
     "heal_duration_s",

@@ -61,13 +61,20 @@ _CONNECT_BACKOFF_BASE_S = 0.1
 # rank + manager address, and the full recovery-destination set) AFTER the v1
 # fields, prefixed by this version number.  v3 adds the spare-replica fields
 # (is_spare, registered spare ids, participant manager addresses) in the
-# same tail.  v1 decoders ignore trailing bytes and v2/v3 decoders treat
-# their absence as "no striping/spare info", so mixed fleets interoperate
-# during a rolling upgrade; pin TORCHFT_WIRE_COMPAT=1 (or 2) on upgraded
-# servers until every client understands the newer version.  The v3 spare
-# fields are additionally emitted only when spare content EXISTS, so a
-# spare-free fleet stays byte-for-byte on the v2 layout.
-MANAGER_QUORUM_WIRE_VERSION = 3
+# same tail.  v4 adds the hierarchical coordination plane: LH_QUORUM_REQ
+# grows a delta-base tail (the requester's last-seen quorum digest, so the
+# lighthouse can answer with a LH_QUORUM_DELTA_RESP instead of the full
+# membership), heartbeats may carry a spare warm-step tail, and the
+# aggregated-beat messages (AGG_BEAT / LH_AGG_BEAT) exist at all.  v1
+# decoders ignore trailing bytes and v2+ decoders treat their absence as
+# "no striping/spare/delta info", so mixed fleets interoperate during a
+# rolling upgrade; pin TORCHFT_WIRE_COMPAT=1/2/3 on upgraded processes
+# until every peer understands the newer version (a v3 pin keeps every
+# frame byte-identical to the pre-v4 protocol).  The v3 spare fields are
+# additionally emitted only when spare content EXISTS, so a spare-free
+# fleet stays byte-for-byte on the v2 layout, and a delta response is only
+# ever sent to a requester that advertised a v4 delta base.
+MANAGER_QUORUM_WIRE_VERSION = 4
 WIRE_COMPAT_ENV = "TORCHFT_WIRE_COMPAT"
 
 # QuorumMember roles (wire v3).  ACTIVE members count toward min_replicas /
@@ -113,6 +120,18 @@ class MsgType(IntEnum):
     LH_HEARTBEAT_RESP = 0x13
     LH_STATUS_REQ = 0x14
     LH_STATUS_RESP = 0x15
+    # Hierarchical coordination plane (wire v4, coord/aggregator.py):
+    # AGG_BEAT is one member's heartbeat to its zone aggregator;
+    # LH_AGG_BEAT is the aggregator's batched upstream flush (one RPC per
+    # tick carrying every member beat collected since the last flush).
+    # LH_QUORUM_DELTA_RESP answers a quorum request whose v4 tail
+    # advertised a delta base the server still holds: membership deltas +
+    # compact per-index step updates instead of the full member list.
+    LH_AGG_BEAT_REQ = 0x16
+    LH_AGG_BEAT_RESP = 0x17
+    LH_QUORUM_DELTA_RESP = 0x18
+    AGG_BEAT_REQ = 0x19
+    AGG_BEAT_RESP = 0x1A
     # Manager service (reference proto/torchft.proto:124-130)
     MGR_QUORUM_REQ = 0x20
     MGR_QUORUM_RESP = 0x21
@@ -484,6 +503,268 @@ class Quorum:
             for s in out.spares:
                 s.role = ROLE_SPARE
         return out
+
+
+def _member_sig(m: QuorumMember) -> tuple:
+    """Canonical identity of one member for digest/delta math: the fixed
+    wire-layout fields only.  ``role`` is deliberately excluded — it never
+    rides the fixed layout (which list a member appears in IS its role), so
+    including it would make server-side digests (which may hold a promoted
+    spare's original role) disagree with a client's decoded view."""
+    return (
+        m.replica_id,
+        m.address,
+        m.store_address,
+        m.step,
+        m.world_size,
+        m.shrink_only,
+        m.commit_failures,
+        m.data,
+    )
+
+
+def _member_static_sig(m: QuorumMember) -> tuple:
+    """Like :func:`_member_sig` minus the per-round movers (step,
+    commit_failures) — members equal under this sig ride a quorum delta as
+    a compact per-index step update instead of a full record."""
+    return (
+        m.replica_id,
+        m.address,
+        m.store_address,
+        m.world_size,
+        m.shrink_only,
+        m.data,
+    )
+
+
+def quorum_digest(quorum: "Quorum") -> int:
+    """Stable 64-bit content digest of a quorum's membership (participants
+    + spares, canonical sorted order), independent of wire version and of
+    ``quorum_id``/``created`` (those ride the delta header).  Both ends of
+    a delta-coded broadcast verify against it."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=8)
+    for m in quorum.participants:
+        h.update(repr(_member_sig(m)).encode())
+    h.update(b"|spares|")
+    for s in quorum.spares:
+        h.update(repr(_member_sig(s)).encode())
+    return int.from_bytes(h.digest(), "little")
+
+
+@dataclass
+class MemberBeat:
+    """One member's heartbeat as carried to (and batched by) a zone
+    aggregator (wire v4).  ``warm_step`` is the spare warm watermark
+    (-1 for actives / unknown) so spare warm-progress rides the aggregate
+    instead of requiring a quorum-RPC re-registration; ``health`` is the
+    same cumulative :class:`CommHealth` summary a direct heartbeat
+    carries."""
+
+    replica_id: str
+    role: int = ROLE_ACTIVE
+    warm_step: int = -1
+    health: Optional[CommHealth] = None
+
+    def encode(self, w: Writer) -> None:
+        w.string(self.replica_id).u8(self.role).i64(self.warm_step)
+        w.boolean(self.health is not None)
+        if self.health is not None:
+            self.health.encode(w)
+
+    @staticmethod
+    def decode(r: Reader) -> "MemberBeat":
+        return MemberBeat(
+            replica_id=r.string(),
+            role=r.u8(),
+            warm_step=r.i64(),
+            health=CommHealth.decode(r) if r.boolean() else None,
+        )
+
+
+@dataclass
+class AggBeat:
+    """One aggregator→lighthouse flush (wire v4): the aggregator's id plus
+    every member beat collected since the previous flush (latest per
+    member).  One upstream RPC per tick replaces one RPC per member per
+    heartbeat interval."""
+
+    agg_id: str
+    beats: List[MemberBeat] = field(default_factory=list)
+
+    def encode(self, w: Writer) -> None:
+        w.string(self.agg_id)
+        w.u32(len(self.beats))
+        for b in self.beats:
+            b.encode(w)
+
+    @staticmethod
+    def decode(r: Reader) -> "AggBeat":
+        return AggBeat(
+            agg_id=r.string(),
+            beats=[MemberBeat.decode(r) for _ in range(r.u32())],
+        )
+
+
+@dataclass
+class QuorumDelta:
+    """Delta-coded quorum broadcast (wire v4): the edit from a base quorum
+    (identified by content digest) to the new one.  Membership changes ride
+    as removals + full upserted member records; members whose only movers
+    are ``step``/``commit_failures`` (the common case — everyone advances
+    one step per round) ride as compact ``(base_index, step,
+    commit_failures)`` triples against the base's canonical sorted order.
+    The receiver applies the edit to its cached base and verifies
+    ``new_digest`` — a mismatch is a protocol error, and the client falls
+    back to a full snapshot on its next request."""
+
+    quorum_id: int = 0
+    created: float = 0.0
+    base_digest: int = 0
+    new_digest: int = 0
+    removed: List[str] = field(default_factory=list)
+    upserts: List[QuorumMember] = field(default_factory=list)
+    step_updates: List[Tuple[int, int, int]] = field(default_factory=list)
+    spare_removed: List[str] = field(default_factory=list)
+    spare_upserts: List[QuorumMember] = field(default_factory=list)
+
+    def encode(self, w: Writer) -> None:
+        w.i64(self.quorum_id).f64(self.created)
+        w.u64(self.base_digest).u64(self.new_digest)
+        w.u32(len(self.removed))
+        for rid in self.removed:
+            w.string(rid)
+        w.u32(len(self.upserts))
+        for m in self.upserts:
+            m.encode(w)
+        w.u32(len(self.step_updates))
+        for idx, step, cf in self.step_updates:
+            w.u32(idx)
+            w.i64(step)
+            w.i64(cf)
+        w.u32(len(self.spare_removed))
+        for rid in self.spare_removed:
+            w.string(rid)
+        w.u32(len(self.spare_upserts))
+        for s in self.spare_upserts:
+            s.encode(w)
+
+    @staticmethod
+    def decode(r: Reader) -> "QuorumDelta":
+        out = QuorumDelta(
+            quorum_id=r.i64(),
+            created=r.f64(),
+            base_digest=r.u64(),
+            new_digest=r.u64(),
+        )
+        out.removed = [r.string() for _ in range(r.u32())]
+        out.upserts = [QuorumMember.decode(r) for _ in range(r.u32())]
+        n_steps = r.u32()
+        for _ in range(n_steps):
+            idx = r.u32()
+            step = r.i64()
+            cf = r.i64()
+            out.step_updates.append((idx, step, cf))
+        out.spare_removed = [r.string() for _ in range(r.u32())]
+        out.spare_upserts = [QuorumMember.decode(r) for _ in range(r.u32())]
+        for s in out.spare_upserts:
+            s.role = ROLE_SPARE
+        return out
+
+
+def make_quorum_delta(base: "Quorum", new: "Quorum") -> QuorumDelta:
+    """Compute the delta turning ``base`` into ``new`` (both in canonical
+    sorted order, as the lighthouse issues them)."""
+    base_map = {m.replica_id: (i, m) for i, m in enumerate(base.participants)}
+    new_ids = {m.replica_id for m in new.participants}
+    delta = QuorumDelta(
+        quorum_id=new.quorum_id,
+        created=new.created,
+        base_digest=quorum_digest(base),
+        new_digest=quorum_digest(new),
+        removed=[rid for rid in base_map if rid not in new_ids],
+    )
+    for m in new.participants:
+        entry = base_map.get(m.replica_id)
+        if entry is None:
+            delta.upserts.append(m)
+            continue
+        idx, bm = entry
+        if _member_sig(m) == _member_sig(bm):
+            continue
+        if _member_static_sig(m) == _member_static_sig(bm):
+            delta.step_updates.append((idx, m.step, m.commit_failures))
+        else:
+            delta.upserts.append(m)
+    base_spares = {s.replica_id: s for s in base.spares}
+    new_spare_ids = {s.replica_id for s in new.spares}
+    delta.spare_removed = [
+        rid for rid in base_spares if rid not in new_spare_ids
+    ]
+    delta.spare_upserts = [
+        s
+        for s in new.spares
+        if s.replica_id not in base_spares
+        or _member_sig(s) != _member_sig(base_spares[s.replica_id])
+    ]
+    return delta
+
+
+def apply_quorum_delta(
+    base: Optional["Quorum"],
+    delta: QuorumDelta,
+    base_digest: Optional[int] = None,
+) -> "Quorum":
+    """Apply one :class:`QuorumDelta` to the cached base quorum, verifying
+    both digests.  Raises :class:`WireError` (INVALID) on any mismatch —
+    the caller must clear its cache so its next request advertises no base
+    and receives a full snapshot."""
+    import dataclasses
+
+    if base is None:
+        raise WireError(ErrCode.INVALID, "quorum delta without a cached base")
+    if base_digest is None:
+        base_digest = quorum_digest(base)
+    if base_digest != delta.base_digest:
+        raise WireError(
+            ErrCode.INVALID,
+            f"quorum delta base digest mismatch "
+            f"(have {base_digest:#x}, delta wants {delta.base_digest:#x})",
+        )
+    parts = list(base.participants)
+    for idx, step, cf in delta.step_updates:
+        if idx >= len(parts):
+            raise WireError(
+                ErrCode.INVALID,
+                f"quorum delta step update index {idx} out of range "
+                f"({len(parts)} base participants)",
+            )
+        parts[idx] = dataclasses.replace(
+            parts[idx], step=step, commit_failures=cf
+        )
+    by_id = {m.replica_id: m for m in parts}
+    for rid in delta.removed:
+        by_id.pop(rid, None)
+    for m in delta.upserts:
+        by_id[m.replica_id] = m
+    spares_by_id = {s.replica_id: s for s in base.spares}
+    for rid in delta.spare_removed:
+        spares_by_id.pop(rid, None)
+    for s in delta.spare_upserts:
+        spares_by_id[s.replica_id] = s
+    out = Quorum(
+        quorum_id=delta.quorum_id,
+        created=delta.created,
+        participants=sorted(by_id.values(), key=lambda m: m.replica_id),
+        spares=sorted(spares_by_id.values(), key=lambda m: m.replica_id),
+    )
+    if quorum_digest(out) != delta.new_digest:
+        raise WireError(
+            ErrCode.INVALID,
+            "quorum delta digest mismatch after apply (divergent base)",
+        )
+    return out
 
 
 @dataclass
