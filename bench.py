@@ -1221,6 +1221,54 @@ def _run_spare_phase(num_replicas: int = 3, steps: int = 10) -> Dict[str, Any]:
                 os.environ[k] = v
 
 
+def _run_degraded_phase(num_replicas: int = 3, steps: int = 10) -> Dict[str, Any]:
+    """Degraded-mode gate (ISSUE 13): two thread-plane drills under the
+    ``wan_1g`` profile — (a) an in-replica device loss absorbed in place
+    (``degraded_step_time_ratio``: wounded-fleet step time vs the pre-wound
+    baseline, zero membership edits), and (b) the same wound with a warm
+    full-width spare registered (``wound_to_swap_s``: wound detection →
+    spare swapped in as ONE membership edit)."""
+    from torchft_tpu.drill import gray_failure_drill
+
+    saved = {k: os.environ.get(k) for k in ("TORCHFT_NET_EMU",)}
+    os.environ["TORCHFT_NET_EMU"] = "wan_1g"
+    out: Dict[str, Any] = {"profile": "wan_1g", "replicas": num_replicas}
+    try:
+        try:
+            wound = gray_failure_drill(
+                mode="device_loss", num_replicas=num_replicas, steps=steps
+            )
+            out.update(
+                degraded_step_time_ratio=wound.get("degraded_step_time_ratio"),
+                capacity_observed=wound.get("capacity_observed"),
+                wound_quorum_reconfigs=wound.get("quorum_reconfigs"),
+                converged=wound.get("converged"),
+            )
+        except Exception as e:  # noqa: BLE001 — a failed drill is a
+            # recorded fact, never a lost artifact
+            out["device_loss_error"] = f"{type(e).__name__}: {e}"
+        try:
+            swap = gray_failure_drill(
+                mode="device_loss_swap",
+                num_replicas=num_replicas,
+                steps=steps,
+            )
+            out.update(
+                wound_to_swap_s=swap.get("wound_to_swap_s"),
+                swaps_total=swap.get("swaps_total"),
+                swap_quorum_reconfigs=swap.get("quorum_reconfigs"),
+            )
+        except Exception as e:  # noqa: BLE001
+            out["swap_error"] = f"{type(e).__name__}: {e}"
+        return out
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _run_coord_phase(num_replicas: int) -> Dict[str, Any]:
     """Coordination-plane scale gate (ISSUE 12): the thread-plane harness
     drives ``num_replicas`` simulated replicas + a spare pool through
@@ -1360,6 +1408,9 @@ def capture_phase_a_subprocess(
     env = dict(os.environ)
     env.pop("TPUFT_BENCH_PLATFORM", None)
     env["TPUFT_BENCH_SKIP_FLEET"] = "1"
+    # the recapture's sole job is TPU phase A: the degraded drills are
+    # platform-independent and already ran (or will) in the parent
+    env["TPUFT_BENCH_SKIP_DEGRADED"] = "1"
     env["TPUFT_BENCH_OUT"] = out_path
     env["TPUFT_BENCH_REPROBE_WINDOW_S"] = "0"  # no recursive recovery
     env["TPUFT_BENCH_PROBE_WINDOW_S"] = str(probe_window_s)
@@ -1614,6 +1665,31 @@ def main() -> None:
             print(f"bench: spare promotion {spare_promotion}", file=sys.stderr)
             _emit_partial(spare_promotion=spare_promotion)
             faults["spare_promotion"] = spare_promotion
+
+    if not os.environ.get("TPUFT_BENCH_SKIP_DEGRADED"):
+        # degraded-mode gate (thread plane, wan_1g): independent of the
+        # fleet phases (it drives its own drill fleet), so it runs — or
+        # records why it didn't — even when the fleet block is skipped;
+        # like the spare phase it costs seconds, so a token budget floor
+        # suffices
+        if remaining_s() > 30.0:
+            degraded = _run_degraded_phase()
+        else:
+            degraded = {
+                "skipped": f"budget exhausted ({remaining_s():.0f}s left)"
+            }
+        print(f"bench: degraded {degraded}", file=sys.stderr)
+        # the two degraded headline keys stream as TOP-LEVEL partial
+        # keys the moment the phase lands, so a watchdog trip still
+        # reports them (the BENCH_r05 lesson)
+        _emit_partial(
+            degraded=degraded,
+            degraded_step_time_ratio=degraded.get(
+                "degraded_step_time_ratio"
+            ),
+            wound_to_swap_s=degraded.get("wound_to_swap_s"),
+        )
+        faults["degraded"] = degraded
 
     coord: Dict[str, Any] = {}
     if not os.environ.get("TPUFT_BENCH_SKIP_COORD"):

@@ -60,10 +60,33 @@ def main() -> None:
     from torchft_tpu.tier import default_tier, make_communicator, manager_server_cls
     from torchft_tpu.manager import Manager
     from torchft_tpu.models.llama import Llama, llama_debug
+    from torchft_tpu.parallel.degraded import (
+        plan_surviving,
+        startup_surviving_devices,
+    )
     from torchft_tpu.parallel.hsdp import HSDPTrainer, fsdp_shardings
     from torchft_tpu.parallel.mesh import make_mesh
 
-    mesh = make_mesh(dp=args.dp, fsdp=args.fsdp, tp=args.tp)
+    # degraded-mode / chaos: TORCHFT_CHAOS_DEVICE_LOSS hides N devices so
+    # this replica comes up wounded — plan the surviving layout and
+    # advertise the capacity fraction instead of dying
+    devices = startup_surviving_devices(jax.devices())
+    wanted = args.dp * args.fsdp * args.tp
+    degraded_plan = None
+    if len(devices) < wanted:
+        degraded_plan = plan_surviving(
+            len(devices), original_devices=wanted
+        )
+        logger.warning(
+            "coming up degraded: %s (capacity %.3f)",
+            degraded_plan.mesh_axes,
+            degraded_plan.capacity,
+        )
+        mesh = make_mesh(devices=devices, **degraded_plan.mesh_axes)
+    else:
+        mesh = make_mesh(
+            dp=args.dp, fsdp=args.fsdp, tp=args.tp, devices=devices
+        )
     config = llama_debug()
     model = Llama(config)
 
@@ -76,6 +99,13 @@ def main() -> None:
         replica_id=f"train_hsdp_{args.replica_group_id}",
         server_cls=manager_server_cls(tier),
     )
+    if degraded_plan is not None:
+        try:
+            manager.complete_relower(degraded_plan.capacity)
+        except RuntimeError as e:
+            # C++ sidecar: no capacity plumbing — run the reduced mesh but
+            # register full-width (docs/operations.md §16 fallback matrix)
+            logger.warning("cannot advertise degraded capacity: %s", e)
     trainer = HSDPTrainer(
         model,
         optax.adamw(1e-3),

@@ -854,6 +854,77 @@ class TestWireProtocol:
         )
         assert findings == []
 
+    # the wire-v5 degraded-capacity tail shape: a DERIVED boolean guard
+    # (`has_capacity_tail = wire_version >= 5 and <degraded>`) gating a
+    # count + f64 loop — the checker must attribute the emits to level 5
+    # through the variable and still demand the symmetric read gate
+    V5_CAPACITY_ONE_SIDED = """
+    def manager_quorum_wire_version():
+        return 5
+
+    class Msg:
+        def encode(self, w):
+            w.i64(self.step)
+            wire_version = manager_quorum_wire_version()
+            has_capacity_tail = wire_version >= 5 and any(
+                c != 1.0 for c in self.capacities
+            )
+            if has_capacity_tail:
+                w.u32(5)
+                w.u32(len(self.capacities))
+                for c in self.capacities:
+                    w.f64(c)
+
+        @staticmethod
+        def decode(r):
+            out = Msg()
+            out.step = r.i64()
+            out.capacities = [r.f64() for _ in range(r.u32())]
+            return out
+    """
+
+    def test_v5_capacity_tail_one_sided_gate_flagged(self):
+        findings = wireproto.check_codec_source(
+            textwrap.dedent(self.V5_CAPACITY_ONE_SIDED), "fixture.py"
+        )
+        assert findings
+        assert any("5" in f.message for f in findings)
+
+    def test_v5_capacity_tail_symmetric_gate_passes(self):
+        findings = wireproto.check_codec_source(
+            textwrap.dedent(
+                """
+                def manager_quorum_wire_version():
+                    return 5
+
+                class Msg:
+                    def encode(self, w):
+                        w.i64(self.step)
+                        wire_version = manager_quorum_wire_version()
+                        has_capacity_tail = wire_version >= 5 and any(
+                            c != 1.0 for c in self.capacities
+                        )
+                        if has_capacity_tail:
+                            w.u32(5)
+                            w.u32(len(self.capacities))
+                            for c in self.capacities:
+                                w.f64(c)
+
+                    @staticmethod
+                    def decode(r):
+                        out = Msg()
+                        out.step = r.i64()
+                        if not r.done() and r.u32() >= 5:
+                            out.capacities = [
+                                r.f64() for _ in range(r.u32())
+                            ]
+                        return out
+                """
+            ),
+            "fixture.py",
+        )
+        assert findings == []
+
     def test_field_order_drift_flagged(self):
         findings = wireproto.check_codec_source(
             textwrap.dedent(

@@ -66,6 +66,16 @@ class Failure(enum.Enum):
     # active fleet must not notice — zero quorum reconfigurations, no
     # stalls, no poisoned state (a spare never counts toward membership
     # and its warm RPCs are served outside the heal path)
+    DEVICE_LOSS = "deviceloss"  # IN-REPLICA device death (wire v5): the
+    # replica must NOT die — it re-lowers its inner mesh onto the
+    # surviving devices (parallel/degraded.py), advertises the reduced
+    # capacity fraction, rescales its data shard, and keeps contributing
+    # through the capacity-weighted outer reduce.  Zero full-replica
+    # evictions; with a warm spare registered, the lighthouse swaps the
+    # wounded replica for the spare in ONE membership edit instead.
+    # kw: devices=N (how many devices die), mid_relower=True arms a crash
+    # BETWEEN begin_relower and complete_relower (the half-relowered
+    # replica must never vote commit).
 
 
 @dataclass
@@ -189,6 +199,10 @@ class ThreadReplica(ReplicaHandle):
             # promoted spare is an active — killing it is Failure.KILL)
             manager = getattr(self._obj, "manager", None)
             return getattr(manager, "role", "active") == "spare"
+        if failure is Failure.DEVICE_LOSS:
+            # the replica loop must expose the degraded-mode hook (it
+            # owns the re-lower — chaos only kills devices)
+            return getattr(self._obj, "device_loss_flag", None) is not None
         if failure in _GRAY_DEFAULT_SPECS:
             comm = getattr(self._obj, "comm", None)
             return callable(getattr(comm, "arm_faults", None))
@@ -223,6 +237,22 @@ class ThreadReplica(ReplicaHandle):
                     f"{self.name}: not a spare in the current epoch"
                 )
             self._obj.kill_flag.set()
+        elif failure is Failure.DEVICE_LOSS:
+            flag = getattr(self._obj, "device_loss_flag", None)
+            if flag is None:
+                raise RuntimeError(
+                    f"{self.name}: no device_loss hook on this replica"
+                )
+            # the replica consumes these at its next step boundary:
+            # devices = how many of its (virtual) devices just died;
+            # mid_relower arms a crash INSIDE the re-lower window — the
+            # kill-mid-relower chaos case proving a half-relowered
+            # replica never votes commit
+            self._obj.device_loss_count = int(kw.get("devices", 1))
+            self._obj.device_loss_mid_relower = bool(
+                kw.get("mid_relower", False)
+            )
+            flag.set()
         elif failure is Failure.DEADLOCK:
             self._obj.wedge_secs = float(kw.get("secs", 10.0))
             self._obj.wedge_flag.set()
@@ -291,9 +321,10 @@ class ProcessReplica(ReplicaHandle):
         self._progress_fn = progress_fn
 
     def supports(self, failure: Failure) -> bool:
-        if failure in _GRAY_DEFAULT_SPECS:
-            # gray failures arm via TORCHFT_NET_FAULTS in the group's spawn
-            # env: supported when the supervisor exposes its specs
+        if failure in _GRAY_DEFAULT_SPECS or failure is Failure.DEVICE_LOSS:
+            # gray failures / device loss arm via the group's spawn env
+            # (TORCHFT_NET_FAULTS / TORCHFT_CHAOS_DEVICE_LOSS): supported
+            # when the supervisor exposes its specs
             return hasattr(self._supervisor, "_specs")
         return failure in (
             Failure.KILL,
@@ -304,6 +335,37 @@ class ProcessReplica(ReplicaHandle):
         )
 
     def inject(self, failure: Failure, **kw: Any) -> None:
+        if failure is Failure.DEVICE_LOSS:
+            # process plane: a real device can't be unplugged from outside
+            # the process, so the loss rides the group's spawn env
+            # (TORCHFT_CHAOS_DEVICE_LOSS=N — the worker hides N devices
+            # and re-lowers at startup) and lands on the next (re)start;
+            # restart=True (default) bounces the process so it comes up
+            # wounded now.  devices=0 heals: the env is cleared and the
+            # next restart comes up full-width.
+            devices = int(kw.get("devices", 1))
+            spec_env = next(
+                (
+                    s.env
+                    for s in self._supervisor._specs
+                    if s.replica_group_id == self._gid
+                ),
+                None,
+            )
+            if spec_env is None:
+                raise RuntimeError(f"{self.name}: no spec for group {self._gid}")
+            if devices <= 0:
+                spec_env.pop("TORCHFT_CHAOS_DEVICE_LOSS", None)
+            else:
+                spec_env["TORCHFT_CHAOS_DEVICE_LOSS"] = str(devices)
+            if kw.get("restart", True):
+                ok = self._supervisor.kill(self._gid, sig=signal.SIGKILL)
+                if not ok:
+                    raise RuntimeError(
+                        f"{self.name}: no live process to restart with "
+                        f"{failure.value}"
+                    )
+            return
         if failure in _GRAY_DEFAULT_SPECS:
             # process plane: the fault program rides the group's spawn env
             # (TORCHFT_NET_FAULTS) and lands on the next (re)start; pass

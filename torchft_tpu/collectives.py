@@ -494,6 +494,7 @@ def outer_sharded_sync(
     row_size: int = DEFAULT_ROW_SIZE,
     timings: Optional[dict] = None,
     tap: Optional[Callable[[np.ndarray], None]] = None,
+    weight: Optional[float] = None,
 ) -> np.ndarray:
     """ZeRO-1-style sharded outer sync: chunk-pipelined
     ``reduce_scatter → sharded outer update → allgather(update)``.
@@ -536,8 +537,20 @@ def outer_sharded_sync(
     update) right before it is returned: the hot-spare delta feed rides
     this hook so parked observers can keep a shadow bit-exact without
     participating in the collective.  A tap failure never fails the sync.
+
+    ``weight``, if given, turns the sync into a capacity-WEIGHTED sum
+    (degraded-mode fleets): this replica's contribution is pre-scaled by
+    its normalized capacity share before quantization/transport and the
+    ``num_participants`` division drops out (weights sum to 1 across the
+    fleet by construction — every rank must pass a weight, or none).  The
+    delta stays bit-identical across replicas exactly as before: the
+    weighting changes the bytes each rank CONTRIBUTES, never how the
+    summed wire-format delta is applied.
     """
     t_wall = time.perf_counter()
+    if weight is not None:
+        flat = np.asarray(flat, dtype=np.float32) * np.float32(weight)
+        num_participants = 1  # weighted contributions need no division
     n = flat.size
     tm = {"scatter_s": 0.0, "update_s": 0.0, "gather_s": 0.0}
     topo = _hier_topology(comm)

@@ -10,20 +10,67 @@ fault tolerance).
 
 Index-based (grain-style) rather than iterator-based: ``__getitem__`` of any
 random-access dataset composes with it.
+
+Degraded-mode rescale (wire v5): when the fleet carries wounded replicas,
+``capacities`` (or :meth:`DistributedSampler.set_capacities`) switches the
+sampler to capacity-PROPORTIONAL shards — a replica running at 0.75 of its
+devices reads ~0.75 of an even share, apportioned deterministically by
+largest remainder (:func:`capacity_shard_counts`) so every replica derives
+the identical partition from the identical quorum facts.  The capacity path
+uses contiguous block partitioning (counts differ per replica, so the
+legacy stride is inapplicable); ``capacities=None`` keeps the legacy
+strided layout bit-for-bit.  Capacity restored mid-run is just
+``set_capacities`` again: the next ``indices()`` call rebalances.
 """
 
 from __future__ import annotations
 
-from typing import Iterator, List
+from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
+
+
+def capacity_shard_counts(total: int, capacities: Sequence[float]) -> List[int]:
+    """Apportion ``total`` samples across replicas proportionally to their
+    capacity fractions, deterministically (largest-remainder method, ties
+    to the lowest replica index).  Pure function of its inputs — every
+    replica computes the identical split from the identical quorum
+    capacities, including when the fractions don't divide the total.
+
+    Zero/negative capacities get zero samples; an all-zero (or empty)
+    capacity vector falls back to an even split so a pathological quorum
+    can never starve the whole fleet."""
+    n = len(capacities)
+    if n == 0:
+        return []
+    weights = np.asarray(
+        [max(0.0, float(c)) for c in capacities], dtype=np.float64
+    )
+    if weights.sum() <= 0.0:
+        weights = np.ones(n, dtype=np.float64)
+    shares = weights / weights.sum() * total
+    counts = np.floor(shares).astype(np.int64)
+    remainder = int(total - counts.sum())
+    if remainder > 0:
+        # largest fractional parts win the leftover samples; ties resolve
+        # to the lowest replica index (argsort is stable on the negated
+        # fractions)
+        order = np.argsort(-(shares - counts), kind="stable")
+        for idx in order[:remainder]:
+            counts[idx] += 1
+    return [int(c) for c in counts]
 
 
 class DistributedSampler:
     """Shards a dataset across replica groups and their workers; this
     worker reads shard ``group_rank + num_workers * replica_rank``
     (``torchft/data.py:24-77`` semantics, documented-lossy on membership
-    change)."""
+    change).
+
+    ``capacities`` (optional, one fraction per replica group in replica-
+    rank order — i.e. aligned with the quorum's sorted replica ids, see
+    ``Manager.participant_capacities``) engages the degraded-mode rescale
+    described in the module docstring."""
 
     def __init__(
         self,
@@ -35,20 +82,61 @@ class DistributedSampler:
         shuffle: bool = True,
         seed: int = 0,
         drop_last: bool = True,
+        capacities: Optional[Sequence[float]] = None,
     ) -> None:
         self._dataset_len = dataset_len
+        self._num_replica_groups = num_replica_groups
+        self._replica_rank = replica_rank
+        self._group_rank = group_rank
+        self._num_workers = num_workers_per_group
         self._num_shards = num_replica_groups * num_workers_per_group
         self._global_rank = group_rank + num_workers_per_group * replica_rank
         self._shuffle = shuffle
         self._seed = seed
         self._drop_last = drop_last
         self._epoch = 0
+        self._capacities: Optional[List[float]] = None
+        self.set_capacities(capacities)
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
 
+    def set_capacities(self, capacities: Optional[Sequence[float]]) -> None:
+        """Switch the shard layout to capacity-proportional apportionment
+        (or back to the legacy even/strided layout with ``None``).  Takes
+        effect on the next ``indices()`` call — capacity restored mid-run
+        rebalances without reconstructing the sampler.  A full-capacity
+        vector is normalized to ``None`` so an unwounded fleet stays on
+        the legacy layout bit-for-bit."""
+        if capacities is not None:
+            if len(capacities) != self._num_replica_groups:
+                raise ValueError(
+                    f"capacities has {len(capacities)} entries for "
+                    f"{self._num_replica_groups} replica groups"
+                )
+            if all(float(c) >= 1.0 for c in capacities):
+                capacities = None
+        self._capacities = (
+            [float(c) for c in capacities] if capacities is not None else None
+        )
+
+    def _usable(self, order_len: int) -> int:
+        return (order_len // self._num_shards) * self._num_shards
+
     @property
     def num_samples(self) -> int:
+        if self._capacities is not None:
+            order_len = self._dataset_len
+            if not self._drop_last:
+                order_len += (-order_len) % self._num_shards
+            counts = capacity_shard_counts(
+                self._usable(order_len), self._capacities
+            )
+            mine = counts[self._replica_rank]
+            # workers split their replica's block evenly, remainder to the
+            # low group ranks — same partition every replica derives
+            per, extra = divmod(mine, self._num_workers)
+            return per + (1 if self._group_rank < extra else 0)
         if self._drop_last:
             return self._dataset_len // self._num_shards
         return -(-self._dataset_len // self._num_shards)
@@ -65,8 +153,20 @@ class DistributedSampler:
             pad = (-len(order)) % self._num_shards
             if pad:
                 order = np.concatenate([order, order[:pad]])
-        usable = (len(order) // self._num_shards) * self._num_shards
-        return list(order[self._global_rank : usable : self._num_shards])
+        usable = self._usable(len(order))
+        if self._capacities is None:
+            return list(order[self._global_rank : usable : self._num_shards])
+        # capacity-proportional contiguous blocks: replica r owns
+        # order[starts[r] : starts[r] + counts[r]], then its workers slice
+        # that block evenly (remainder to the low ranks).  A partition —
+        # never an overlap, never a dropped sample inside ``usable``.
+        counts = capacity_shard_counts(usable, self._capacities)
+        start = int(sum(counts[: self._replica_rank]))
+        block = order[start : start + counts[self._replica_rank]]
+        per, extra = divmod(len(block), self._num_workers)
+        w_start = self._group_rank * per + min(self._group_rank, extra)
+        w_len = per + (1 if self._group_rank < extra else 0)
+        return list(block[w_start : w_start + w_len])
 
     def __iter__(self) -> Iterator[int]:
         return iter(self.indices())

@@ -78,12 +78,41 @@ def gray_failure_drill(
     - ``kill_spare``: the spare is killed MID-WARM; the active fleet must
       finish every step with ZERO quorum reconfigurations and bit-identical
       params — a dying spare never poisons or stalls the fleet.
+    - ``device_loss``: one replica loses an IN-replica device mid-run and
+      must NOT die: it re-lowers onto the survivors
+      (``parallel.degraded``), advertises the reduced capacity (wire v5),
+      rescales its data shard, and the fleet keeps committing with ZERO
+      full-replica evictions and ZERO reconfigs; final params are
+      bit-identical across the fleet and allclose to an unwounded run at
+      equal total samples (the capacity-weighted average of capacity-
+      proportional shards IS the global average).
+    - ``device_loss_swap``: same wound with a warm full-width spare
+      registered — the lighthouse must trade the wounded replica for the
+      spare in EXACTLY ONE membership edit (promotion preferred over
+      degradation); the report carries ``wound_to_swap_s``.
+    - ``device_loss_kill_mid_relower``: the wounded replica dies BETWEEN
+      ``begin_relower`` and ``complete_relower``; the drill proves the
+      half-relowered replica never voted commit and the survivors carry
+      on.
 
     Returns summary facts (also asserted internally)."""
     from torchft_tpu.chaos import ChaosController, Failure, ThreadReplica
     from torchft_tpu.communicator import TCPCommunicator
     from torchft_tpu.lighthouse import LighthouseServer
     from torchft_tpu.manager import Manager
+
+    if mode in (
+        "device_loss",
+        "device_loss_swap",
+        "device_loss_kill_mid_relower",
+    ):
+        return _device_loss_drill(
+            mode=mode,
+            num_replicas=num_replicas,
+            steps=steps,
+            arm_at_step=arm_at_step,
+            timeout_s=timeout_s,
+        )
 
     if mode in ("spare_promote", "kill_spare"):
         # hot-spare chaos rides the same drill surface (and report keys:
@@ -593,6 +622,443 @@ def _spare_drill(
             t.join(timeout=5.0)
         agent.close()
         for r in actives + [spare]:
+            try:
+                r.manager.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        lighthouse.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return result
+
+
+def _device_loss_drill(
+    mode: str,
+    num_replicas: int = 3,
+    steps: int = 12,
+    arm_at_step: int = 3,
+    timeout_s: float = 20.0,
+    devices_per_replica: int = 4,
+    dim: int = 32,
+    lr: float = 0.1,
+) -> Dict[str, Any]:
+    """Degraded-mode chaos (see :func:`gray_failure_drill` for the mode
+    contracts): an IN-replica device dies and the replica must keep
+    contributing at reduced capacity instead of failing whole.
+
+    Each replica simulates ``devices_per_replica`` virtual devices and
+    trains a shared linear objective over a capacity-rescaled data shard
+    (``data.DistributedSampler(capacities=...)`` driven by the quorum's
+    wire-v5 capacity vector); gradients average through the Manager's
+    capacity-WEIGHTED path.  Because capacity-proportional shards
+    partition the same sample set an unwounded fleet covers, the weighted
+    average IS the global average — the wounded run must land allclose to
+    the analytic unwounded trajectory at equal total samples, and
+    bit-identical across the fleet."""
+    from torchft_tpu.chaos import ChaosController, Failure, ThreadReplica
+    from torchft_tpu.communicator import TCPCommunicator
+    from torchft_tpu.data import DistributedSampler
+    from torchft_tpu.lighthouse import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.parallel.degraded import plan_surviving
+    from torchft_tpu.spare import SpareAgent
+
+    assert mode in (
+        "device_loss",
+        "device_loss_swap",
+        "device_loss_kill_mid_relower",
+    ), mode
+    assert num_replicas >= 3, "device-loss drills need a surviving majority"
+    with_spare = mode == "device_loss_swap"
+    mid_kill = mode == "device_loss_kill_mid_relower"
+
+    # dataset: divisible by every shard count in play so the legacy and
+    # capacity partitions trim identically; nonzero mean so the reference
+    # trajectory is a real signal, not noise
+    n_samples = num_replicas * 240
+    data_rng = np.random.default_rng(11)
+    X = data_rng.normal(loc=1.0, size=(n_samples, dim)).astype(np.float32)
+
+    saved_env = {
+        k: os.environ.get(k)
+        for k in (
+            "TORCHFT_SPARE_WARM_REFRESH_S",
+            "TORCHFT_SPARE_PROMOTE",
+            "TORCHFT_DEGRADED_SWAP",
+        )
+    }
+    if with_spare:
+        os.environ["TORCHFT_SPARE_WARM_REFRESH_S"] = "0"
+        # promotion (and thus the swap) stays off until the fleet is armed
+        # — same startup-scramble hazard _spare_drill documents
+        os.environ["TORCHFT_SPARE_PROMOTE"] = "0"
+        os.environ["TORCHFT_DEGRADED_SWAP"] = "1"
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=num_replicas - 1,
+        join_timeout_ms=300,
+        quorum_tick_ms=10,
+        heartbeat_timeout_ms=1000 if mid_kill else 1500,
+    )
+
+    wound_ts: List[float] = [0.0]
+    promoted_ts: List[float] = [0.0]
+    mid_commit: List[Optional[bool]] = [None]
+    stop = threading.Event()
+    warm_gate = threading.Event()
+    promoted = threading.Event()
+    if not with_spare:
+        warm_gate.set()
+
+    class _Rep:
+        def __init__(self, idx: int, role: str = "active") -> None:
+            self.idx = idx
+            self.rid = f"degr_{role}_{idx}"
+            self.role = role
+            self.devices = devices_per_replica
+            self.capacity = 1.0
+            self.params = np.zeros(dim, dtype=np.float32)
+            self.comm = TCPCommunicator(timeout_s=timeout_s)
+            self.manager = Manager(
+                comm=self.comm,
+                load_state_dict=self._load,
+                state_dict=self._save,
+                min_replica_size=num_replicas - 1,
+                replica_id=self.rid,
+                lighthouse_addr=lighthouse.local_address(),
+                timeout=timeout_s,
+                quorum_timeout=timeout_s,
+                connect_timeout=timeout_s,
+                role=role,
+                # every replica starts from the same zeros, so the
+                # init-sync force-heal round (where healers contribute
+                # zeros and the first committed average is 1/N-scaled)
+                # would only distort the analytic reference trajectory
+                init_sync=False,
+            )
+            self.commits = 0
+            self.reconfigs_after_arm = 0
+            self.qid_at_arm: Optional[int] = None
+            self.step_times: List[float] = []
+            self.wounded = False
+            self.excluded = False
+            self.kill_flag = threading.Event()
+            # chaos hooks (ThreadReplica DEVICE_LOSS support)
+            self.device_loss_flag = threading.Event()
+            self.device_loss_count = 1
+            self.device_loss_mid_relower = False
+
+        def _save(self) -> Dict[str, Any]:
+            return {"params": self.params.copy()}
+
+        def _load(self, sd: Dict[str, Any]) -> None:
+            self.params = np.asarray(sd["params"], dtype=np.float32).copy()
+
+        def _grad(self) -> np.ndarray:
+            """This replica's shard gradient under the CURRENT quorum:
+            rank/world/capacities all come from the quorum result, so the
+            partition (and the capacity rescale) is identical on every
+            replica — including across a swap, where ranks shift."""
+            rank = self.manager.participating_rank()
+            world = self.manager.num_participants()
+            if rank is None or world < 1:
+                return np.zeros(dim, dtype=np.float32)
+            caps = self.manager.participant_capacities()
+            sampler = DistributedSampler(
+                n_samples,
+                replica_rank=rank,
+                num_replica_groups=world,
+                shuffle=True,
+                seed=5,
+                capacities=caps if len(caps) == world else None,
+            )
+            sampler.set_epoch(self.manager.current_step())
+            idxs = sampler.indices()
+            if not idxs:
+                return np.zeros(dim, dtype=np.float32)
+            return X[np.asarray(idxs)].mean(axis=0)
+
+        def _relower(self) -> None:
+            """Consume an armed device loss at a step boundary: fence the
+            vote, plan the surviving layout via the rehearsal-backed
+            planner, and advertise the new capacity."""
+            self.wounded = True
+            wound_ts[0] = wound_ts[0] or time.monotonic()
+            self.manager.begin_relower()
+            if self.device_loss_mid_relower:
+                # the kill-mid-relower chaos case: run one step INSIDE the
+                # fence — the vote must come back False — then die hard
+                try:
+                    self.manager.start_quorum()
+                    work = self.manager.allreduce(self._grad())
+                    work.wait(timeout=timeout_s)
+                    mid_commit[0] = self.manager.should_commit()
+                except Exception:  # noqa: BLE001 — a failed step is a no
+                    mid_commit[0] = False
+                self.manager.shutdown()
+                return
+            survivors = max(1, self.devices - self.device_loss_count)
+            plan = plan_surviving(
+                survivors, original_devices=self.devices
+            )
+            self.capacity = plan.capacity
+            self.manager.complete_relower(plan.capacity)
+
+        def active_loop(self, stop: threading.Event) -> None:
+            while not stop.is_set() and self.manager.current_step() < steps:
+                if (
+                    not warm_gate.is_set()
+                    and self.manager.current_step() >= arm_at_step + 2
+                ):
+                    # don't burn the step budget before the spare warms
+                    warm_gate.wait(timeout=120.0)
+                if self.device_loss_flag.is_set() and not self.wounded:
+                    self._relower()
+                    if self.device_loss_mid_relower:
+                        return
+                t0 = time.monotonic()
+                try:
+                    self.manager.start_quorum()
+                    work = self.manager.allreduce(self._grad())
+                    avg = work.wait(timeout=timeout_s)
+                    ok = self.manager.should_commit()
+                except Exception:  # noqa: BLE001 — a failed step, not a crash
+                    ok = False
+                if ok and not stop.is_set():
+                    self.params -= lr * np.asarray(avg, dtype=np.float32)
+                    self.commits += 1
+                    self.step_times.append(time.monotonic() - t0)
+                    if (
+                        self.qid_at_arm is not None
+                        and self.manager._quorum_id != self.qid_at_arm
+                    ):
+                        self.reconfigs_after_arm += 1
+                        self.qid_at_arm = self.manager._quorum_id
+                elif self.wounded and with_spare and not stop.is_set():
+                    # swapped out?  stop burning quorum RPCs once the
+                    # lighthouse has visibly moved on without us
+                    try:
+                        status = lighthouse._status()
+                    except Exception:  # noqa: BLE001
+                        continue
+                    ids = [
+                        p["replica_id"] for p in status["participants"]
+                    ]
+                    if ids and all(not i.startswith(self.rid) for i in ids):
+                        self.excluded = True
+                        return
+
+    actives = [_Rep(i) for i in range(num_replicas)]
+    spare = _Rep(num_replicas, role="spare") if with_spare else None
+    agent = SpareAgent(spare.manager) if spare is not None else None
+
+    def spare_loop() -> None:
+        assert spare is not None and agent is not None
+        while not stop.is_set() and not spare.kill_flag.is_set():
+            if agent.step(park_timeout_s=1.0):
+                promoted_ts[0] = time.monotonic()
+                promoted.set()
+                spare.active_loop(stop)
+                return
+
+    victim = actives[num_replicas - 1]
+    chaos = ChaosController(
+        [ThreadReplica(r.rid, r) for r in actives]
+        + ([ThreadReplica("spare", spare)] if spare is not None else [])
+    )
+    threads = [
+        threading.Thread(target=r.active_loop, args=(stop,), daemon=True)
+        for r in actives
+    ]
+    spare_thread = (
+        threading.Thread(target=spare_loop, daemon=True) if spare else None
+    )
+    result: Dict[str, Any] = {}
+    try:
+        for t in threads:
+            t.start()
+        if spare_thread is not None:
+            spare_thread.start()
+        deadline = time.monotonic() + 120.0
+        while (
+            min(r.commits for r in actives) < arm_at_step
+            or (agent is not None and agent.warm_step < 1)
+        ) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert min(r.commits for r in actives) >= arm_at_step, (
+            "fleet never reached the arming step"
+        )
+        if agent is not None:
+            assert agent.warm_step >= 1, "spare never warmed"
+            os.environ["TORCHFT_SPARE_PROMOTE"] = "1"
+        for r in actives:
+            r.qid_at_arm = r.manager._quorum_id
+        pre_wound_times = {
+            r.idx: list(r.step_times) for r in actives
+        }
+        warm_gate.set()
+        chaos.inject(
+            Failure.DEVICE_LOSS,
+            victim=chaos.replicas[victim.idx],
+            devices=1,
+            mid_relower=mid_kill,
+        )
+
+        if mode == "device_loss":
+            deadline = time.monotonic() + 240.0
+            while (
+                min(r.commits for r in actives) < steps
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            stop.set()
+            for t in threads:
+                t.join(timeout=2 * timeout_s + 10.0)
+            assert all(r.commits >= steps for r in actives), (
+                f"fleet stalled after device loss: "
+                f"{[r.commits for r in actives]}"
+            )
+            # ZERO full-replica evictions and ZERO membership edits: the
+            # wound is absorbed in place
+            reconfigs = sum(r.reconfigs_after_arm for r in actives)
+            assert reconfigs == 0, (
+                f"{reconfigs} quorum reconfigurations after device loss "
+                "(the wound must be absorbed without a membership edit)"
+            )
+            status = lighthouse._status()
+            assert status["evictions_total"] == 0, status
+            assert status["degraded_evictions_total"] == 0, status
+            wounded_rows = {
+                d["replica_id"]: d["capacity"]
+                for d in status["degraded_replicas"]
+            }
+            assert any(
+                rid.startswith(victim.rid) for rid in wounded_rows
+            ), f"lighthouse never saw the wound: {status}"
+            fleet = list(actives)
+            # step-time ratio for the bench's degraded phase
+            base = [
+                float(np.median(pre_wound_times[r.idx]))
+                for r in actives
+                if pre_wound_times[r.idx]
+            ]
+            tail = [
+                float(np.median(r.step_times[-4:]))
+                for r in actives
+                if len(r.step_times) >= 4
+            ]
+            if base and tail:
+                result["degraded_step_time_ratio"] = round(
+                    float(np.mean(tail)) / max(1e-9, float(np.mean(base))), 3
+                )
+            result.update(
+                capacity_observed=min(wounded_rows.values()),
+                quorum_reconfigs=0,
+                evictions_total=0,
+            )
+        elif with_spare:
+            assert promoted.wait(timeout=60.0), (
+                "wounded replica was never swapped for the spare"
+            )
+            survivors = [r for r in actives if r is not victim]
+            fleet = survivors + [spare]
+            deadline = time.monotonic() + 240.0
+            while (
+                min(r.manager.current_step() for r in fleet) < steps
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            stop.set()
+            join_list = threads + (
+                [spare_thread] if spare_thread is not None else []
+            )
+            for t in join_list:
+                t.join(timeout=2 * timeout_s + 10.0)
+            assert all(
+                r.manager.current_step() >= steps for r in fleet
+            ), f"fleet stalled after swap: {[r.commits for r in fleet]}"
+            status = lighthouse._status()
+            assert status["swaps_total"] >= 1, status
+            ids = [p["replica_id"] for p in status["participants"]]
+            assert all(not i.startswith(victim.rid) for i in ids), (
+                f"wounded replica still in quorum after swap: {ids}"
+            )
+            # the ONE membership edit: wounded out + spare in, same
+            # quorum computation
+            assert all(r.reconfigs_after_arm == 1 for r in survivors), (
+                f"expected exactly one membership edit: "
+                f"{[r.reconfigs_after_arm for r in survivors]}"
+            )
+            result.update(
+                wound_to_swap_s=round(promoted_ts[0] - wound_ts[0], 3),
+                swaps_total=status["swaps_total"],
+                promotions_total=status["promotions_total"],
+                quorum_reconfigs=survivors[0].reconfigs_after_arm,
+                victim_excluded=True,
+            )
+        else:  # device_loss_kill_mid_relower
+            survivors = [r for r in actives if r is not victim]
+            fleet = survivors
+            deadline = time.monotonic() + 240.0
+            while (
+                min(r.commits for r in survivors) < steps
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            stop.set()
+            for t in threads:
+                t.join(timeout=2 * timeout_s + 10.0)
+            assert all(r.commits >= steps for r in survivors), (
+                f"survivors stalled after mid-relower death: "
+                f"{[r.commits for r in survivors]}"
+            )
+            # the core proof: the half-relowered replica's one vote inside
+            # the begin_relower/complete_relower window came back False
+            assert mid_commit[0] is False, (
+                f"half-relowered replica voted commit={mid_commit[0]}"
+            )
+            result.update(
+                mid_relower_commit=False,
+                quorum_reconfigs=sum(
+                    r.reconfigs_after_arm for r in survivors
+                ),
+            )
+
+        # bit-identity: the capacity-weighted outer reduce fans the same
+        # averaged bytes to every replica — params must never fork
+        ref_params = fleet[0].params
+        for other in fleet[1:]:
+            assert np.array_equal(ref_params, other.params), (
+                f"fleet params diverged ({fleet[0].rid} vs {other.rid})"
+            )
+        if mode == "device_loss":
+            # convergence: allclose vs the analytic unwounded run at equal
+            # total samples — capacity-proportional shards partition the
+            # same usable set, so the weighted average IS the global
+            # average (up to largest-remainder rounding)
+            expected = -lr * steps * X.mean(axis=0)
+            np.testing.assert_allclose(
+                fleet[0].params, expected, rtol=2e-2, atol=2e-2
+            )
+            result["converged"] = True
+        result["commits"] = [r.commits for r in fleet]
+    finally:
+        stop.set()
+        warm_gate.set()
+        if spare is not None:
+            spare.kill_flag.set()
+        join_list = threads + (
+            [spare_thread] if spare_thread is not None else []
+        )
+        for t in join_list:
+            t.join(timeout=5.0)
+        if agent is not None:
+            agent.close()
+        for r in actives + ([spare] if spare is not None else []):
             try:
                 r.manager.shutdown()
             except Exception:  # noqa: BLE001

@@ -73,8 +73,8 @@ _k("TORCHFT_QUORUM_RETRIES", "int", "0",
    "Consecutive failed-quorum retries before the manager raises")
 _k("TORCHFT_CONNECT_RETRIES", "int", "3",
    "Dial attempts with jittered exponential backoff inside the connect deadline")
-_k("TORCHFT_WIRE_COMPAT", "int", "4 (current)",
-   "Pin the control-plane wire version during rolling upgrades (1..4; 3 disables the v4 coordination plane)")
+_k("TORCHFT_WIRE_COMPAT", "int", "5 (current)",
+   "Pin the control-plane wire version during rolling upgrades (1..5; 4 pins pre-v5 bytes, 3 disables the v4 coordination plane)")
 _k("TORCHFT_WATCHDOG_TIMEOUT_SEC", "float", "0 (off)",
    "Futures watchdog: log+dump stacks when an op exceeds this bound")
 _k("TORCHFT_TIER", "str", "auto",
@@ -163,6 +163,13 @@ _k("TORCHFT_OUTER_SHARD", "str", "auto",
    "ZeRO-1-style sharded outer sync: auto | 0 | 1 (0 = legacy replicated path)")
 _k("TORCHFT_OUTER_CHUNK_MB", "float", "16",
    "Pipelined outer-sync chunk size (MiB, capped at 64 chunks)")
+# --- degraded mode (in-replica device loss, wire v5) ------------------------
+_k("TORCHFT_DEGRADED_MIN_FRAC", "float", "0 (never)",
+   "Capacity floor: evict a replica wounded below this fraction (never below min_replicas/majority)")
+_k("TORCHFT_DEGRADED_SWAP", "bool", "1",
+   "Swap a wounded replica for a warm full-width spare in one membership edit (promotion preferred over degradation)")
+_k("TORCHFT_CHAOS_DEVICE_LOSS", "int", "unset",
+   "Chaos (process plane): hide N devices at startup so the replica comes up wounded and re-lowers")
 # --- hot spares -------------------------------------------------------------
 _k("TORCHFT_SPARE_PROMOTE", "bool", "1",
    "Allow the lighthouse to promote a warmed spare when an active dies")
@@ -265,6 +272,8 @@ _k("TPUFT_BENCH_SKIP_SPARE", "bool", "0",
    "Skip the hot-spare promotion bench phase", "bench")
 _k("TPUFT_BENCH_SKIP_COORD", "bool", "0",
    "Skip the coordination-plane scale phase", "bench")
+_k("TPUFT_BENCH_SKIP_DEGRADED", "bool", "0",
+   "Skip the degraded-mode (device-loss) bench phase", "bench")
 _k("TPUFT_BENCH_COORD_REPLICAS", "int", "120 cpu / 500 tpu",
    "Simulated replicas driven by the coordination scale phase", "bench")
 _k("TPUFT_BENCH_PROBE_TIMEOUT_S", "float", "180",

@@ -125,6 +125,35 @@ def _spare_promote_enabled() -> bool:
     return knobs.get_bool(SPARE_PROMOTE_ENV, True)
 
 
+# Degraded-mode policy (wire v5).  A replica that lost in-replica devices
+# re-lowers onto the survivors and advertises a capacity fraction instead
+# of dying; the lighthouse treats that fraction as a first-class policy
+# input with a three-rung ladder:
+#
+#   wound  — the fleet keeps the wounded replica (reduced data shard,
+#            weighted outer reduce); zero membership edits.
+#   swap   — promotion preferred over degradation: when a full-width warm
+#            spare is registered, the wounded replica trades places with
+#            it in ONE membership edit (same quorum computation, like
+#            hold-the-shrink).  The swapped-out replica stays excluded
+#            while it remains degraded and is re-admitted the moment it
+#            re-registers at full capacity.
+#   evict  — a replica wounded below TORCHFT_DEGRADED_MIN_FRAC is shed
+#            from the quorum (never below min_replicas or the
+#            anti-split-brain majority: a limping replica still beats no
+#            quorum).  0 (the default) disables floor eviction.
+DEGRADED_MIN_FRAC_ENV = "TORCHFT_DEGRADED_MIN_FRAC"
+DEGRADED_SWAP_ENV = "TORCHFT_DEGRADED_SWAP"
+
+
+def _degraded_min_frac() -> float:
+    return knobs.get_float(DEGRADED_MIN_FRAC_ENV, 0.0)
+
+
+def _degraded_swap_enabled() -> bool:
+    return knobs.get_bool(DEGRADED_SWAP_ENV, True)
+
+
 # Hierarchical coordination plane (wire v4).  Zone aggregators batch member
 # heartbeats into one upstream RPC per flush tick (LH_AGG_BEAT_REQ); the
 # lighthouse remembers which aggregator last reported each member.  When an
@@ -285,6 +314,15 @@ class _State:
     # rate limit for the note_health stale-entry prune (an O(members)
     # sweep per beat would be O(N^2)/s at fleet scale)
     health_pruned_ts: float = 0.0
+    # degraded-mode (wire v5): wounded replicas a full-width spare swapped
+    # out — excluded from quorums while they remain degraded, re-admitted
+    # when they re-register at full capacity; plus the floor-eviction
+    # accounting twins of evicted_now/evicted_prev/evictions_total
+    degraded_swapped: set = field(default_factory=set)
+    degraded_evicted_now: List[str] = field(default_factory=list)
+    degraded_evicted_prev: set = field(default_factory=set)
+    degraded_evictions_total: int = 0
+    swaps_total: int = 0
 
 
 # health entries stop counting as straggler-median "reporters" after this
@@ -375,6 +413,28 @@ def _note_warm_step(state: "_State", replica_id: str, warm_step: int) -> None:
         details.member = dataclasses.replace(details.member, step=warm_step)
 
 
+def _note_capacity(state: "_State", replica_id: str, capacity: float) -> None:
+    """Fold a beat-carried degraded-capacity fraction (wire v5) into the
+    registration record, so the wound→swap→evict ladder reacts at beat
+    cadence instead of waiting for the next quorum-RPC registration.
+    Copy-on-write for the same reason as :func:`_note_warm_step` — the
+    registered member object is shared by reference with issued quorums
+    whose digests were stamped at issue time.  The function is total (a
+    full-capacity report lifts the swapped-out exclusion too), but note
+    the live beat encoder only ever carries DEGRADED fractions — healed
+    re-admission in practice rides the full-capacity quorum registration
+    (:meth:`LighthouseServer._register`), which happens every round.
+    Caller holds the server lock."""
+    capacity = min(1.0, max(0.0, capacity))
+    details = state.participants.get(replica_id)
+    if details is not None and details.member.capacity != capacity:
+        import dataclasses
+
+        details.member = dataclasses.replace(details.member, capacity=capacity)
+    if capacity >= 1.0:
+        state.degraded_swapped.discard(replica_id)
+
+
 def _promote_spares(
     now: float, state: _State, cfg: LighthouseConfig, healthy_replicas: set
 ) -> None:
@@ -410,7 +470,7 @@ def _promote_spares(
         if rid in state.promoted and rid not in prev_ids
     )
     slots = len(dead_prev) - already_replacing
-    if slots <= 0 or not state.spares:
+    if not state.spares:
         return
     eligible = [
         d
@@ -432,7 +492,7 @@ def _promote_spares(
         ]
     # freshest first (max warm step), ties to the lowest replica_id
     eligible.sort(key=lambda d: (-d.member.step, d.member.replica_id))
-    for details in eligible[:slots]:
+    for details in eligible[: max(0, slots)]:
         rid = details.member.replica_id
         state.spares.pop(rid)
         state.spare_ids.discard(rid)
@@ -448,6 +508,56 @@ def _promote_spares(
             rid,
             details.member.step,
             ", ".join(sorted(dead_prev)),
+        )
+    # Swap rung of the degraded ladder: promotion preferred over
+    # degradation.  With warm spares left over after death replacement, a
+    # WOUNDED participant (capacity < 1, alive and registered) trades
+    # places with a full-width spare in this same computation — wounded
+    # out + spare in is ONE membership edit, exactly like hold-the-shrink
+    # turns a death into one edit.  The swapped-out replica stays
+    # excluded from future quorums (quorum_compute's degraded filter)
+    # while it remains degraded, and is re-admitted the moment it
+    # re-registers at full capacity.
+    if not _degraded_swap_enabled():
+        return
+    remaining = [d for d in eligible if d.member.replica_id in state.spares]
+    wounded = sorted(
+        (
+            d
+            for rid, d in state.participants.items()
+            if d.member.capacity < 1.0
+            and rid in healthy_replicas
+            and rid not in state.promoted
+            # already swapped out: the excluded replica keeps re-registering
+            # while degraded — swapping it AGAIN would burn a second spare
+            # on the same wound and grow the quorum by one per round
+            and rid not in state.degraded_swapped
+        ),
+        # most-wounded first, ties to the lowest replica_id
+        key=lambda d: (d.member.capacity, d.member.replica_id),
+    )
+    for details, victim in zip(remaining, wounded):
+        rid = details.member.replica_id
+        wid = victim.member.replica_id
+        state.spares.pop(rid)
+        state.spare_ids.discard(rid)
+        state.promoted.add(rid)
+        state.participants.pop(wid, None)
+        state.degraded_swapped.add(wid)
+        state.participants[rid] = _MemberDetails(
+            joined=now, member=details.member
+        )
+        healthy_replicas.add(rid)
+        state.promoted_now.append(rid)
+        state.promotions_total += 1
+        state.swaps_total += 1
+        logger.warning(
+            "swapping wounded %s (capacity %.2f) for full-width spare %s "
+            "(warm step %d) — one membership edit",
+            wid,
+            victim.member.capacity,
+            rid,
+            details.member.step,
         )
 
 
@@ -503,6 +613,36 @@ def quorum_compute(
             )
             candidates = keep
 
+    # degraded-mode ladder, rungs 2 and 3 (see DEGRADED_MIN_FRAC_ENV):
+    # swapped-out wounded replicas stay excluded while degraded, and a
+    # replica wounded below the capacity floor is evicted — both behind
+    # the same never-below-min_replicas/majority guard as straggler
+    # eviction.  Runs BEFORE the fast-quorum path so a wounded-but-
+    # healthy-looking round still sheds/swaps.
+    state.degraded_evicted_now = []
+    min_frac = _degraded_min_frac()
+    swapped_out = {
+        m.replica_id
+        for m in candidates
+        if m.capacity < 1.0 and m.replica_id in state.degraded_swapped
+    }
+    floor_evict = {
+        m.replica_id
+        for m in candidates
+        if min_frac > 0.0
+        and m.capacity < min_frac
+        and m.replica_id not in swapped_out
+    }
+    if swapped_out or floor_evict:
+        drop = swapped_out | floor_evict
+        keep = [m for m in candidates if m.replica_id not in drop]
+        if (
+            len(keep) >= cfg.min_replicas
+            and len(keep) > len(healthy_replicas) // 2
+        ):
+            state.degraded_evicted_now = sorted(floor_evict)
+            candidates = keep
+
     metadata = (
         f"[{len(healthy_participants)}/{len(state.participants)} participants healthy]"
         f"[{len(healthy_replicas)} heartbeating][shrink_only={shrink_only}]"
@@ -517,6 +657,17 @@ def quorum_compute(
             else ""
         )
         + (f"[{len(state.spares)} spares]" if state.spares else "")
+        + (
+            f"[evicting degraded below {min_frac}: "
+            f"{', '.join(state.degraded_evicted_now)}]"
+            if state.degraded_evicted_now
+            else ""
+        )
+        + (
+            f"[swapped-out degraded excluded: {', '.join(sorted(swapped_out))}]"
+            if swapped_out
+            else ""
+        )
     )
 
     if state.prev_quorum is not None:
@@ -773,6 +924,20 @@ class LighthouseServer:
             logger.warning(
                 "quorum sheds slow replica(s): %s", ", ".join(newly_shed)
             )
+        # degraded floor evictions: same transition-based accounting
+        newly_floor_shed = [
+            r
+            for r in state.degraded_evicted_now
+            if r not in state.degraded_evicted_prev
+        ]
+        state.degraded_evicted_prev = set(state.degraded_evicted_now)
+        if newly_floor_shed:
+            state.degraded_evictions_total += len(newly_floor_shed)
+            logger.warning(
+                "quorum evicts replica(s) wounded below the capacity "
+                "floor: %s",
+                ", ".join(newly_floor_shed),
+            )
         if state.prev_quorum is None or _quorum_changed(
             participants, state.prev_quorum.participants
         ):
@@ -907,6 +1072,10 @@ class LighthouseServer:
                     warm_step = None
                     if not r.done() and r.u8():
                         warm_step = r.i64()
+                    # optional v5 degraded-capacity tail (flag byte + f64)
+                    capacity = None
+                    if not r.done() and r.u8():
+                        capacity = r.f64()
                     with self._lock:
                         now = time.monotonic()
                         state = self._state
@@ -918,6 +1087,8 @@ class LighthouseServer:
                             note_health(state, replica_id, health, now)
                         if warm_step is not None:
                             _note_warm_step(state, replica_id, warm_step)
+                        if capacity is not None:
+                            _note_capacity(state, replica_id, capacity)
                     send_frame(conn, MsgType.LH_HEARTBEAT_RESP)
                 elif msg_type == MsgType.LH_AGG_BEAT_REQ:
                     # one aggregator flush: every member beat it batched
@@ -980,6 +1151,11 @@ class LighthouseServer:
             # was never a spare); either way this id now counts as active
             state.promoted.discard(rid)
             state.spare_ids.discard(rid)
+        if requester.capacity >= 1.0:
+            # a full-capacity registration lifts the swapped-out exclusion:
+            # the wounded replica healed (or restarted full-width) and is
+            # an ordinary candidate again
+            state.degraded_swapped.discard(rid)
         state.spares.pop(rid, None)
         state.participants[rid] = _MemberDetails(joined=now, member=requester)
 
@@ -997,6 +1173,8 @@ class LighthouseServer:
             if tail_version >= 4 and r.boolean():
                 r.i64()  # base quorum_id (diagnostic only)
                 base_digest = r.u64()
+            if tail_version >= 5:
+                requester.capacity = min(1.0, max(0.0, r.f64()))
         deadline = time.monotonic() + timeout_ms / 1000.0
         logger.info("Received quorum request for replica %s", requester.replica_id)
 
@@ -1211,6 +1389,9 @@ class LighthouseServer:
                         "store_address": p.store_address,
                         "step": p.step,
                         "world_size": p.world_size,
+                        # degraded-mode capacity column: 1.0 = full width;
+                        # a dashboard spots wounded replicas at a glance
+                        "capacity": p.capacity,
                     }
                     for p in (prev.participants if prev else [])
                 ],
@@ -1259,6 +1440,22 @@ class LighthouseServer:
                     for _rid, d in sorted(self._state.spares.items())
                 ],
                 "promotions_total": self._state.promotions_total,
+                # degraded-mode ladder facts: who is wounded (and how
+                # deep), who a spare swapped out, and the floor/eviction
+                # policy counters — served from this same TTL-cached
+                # snapshot, so the dashboard fleet adds no lock traffic
+                "degraded_replicas": [
+                    {"replica_id": p.replica_id, "capacity": p.capacity}
+                    for p in (prev.participants if prev else [])
+                    if p.capacity < 1.0
+                ],
+                "degraded_swapped_out": sorted(self._state.degraded_swapped),
+                "degraded_min_frac": _degraded_min_frac(),
+                "degraded_swap_enabled": _degraded_swap_enabled(),
+                "degraded_evictions_total": (
+                    self._state.degraded_evictions_total
+                ),
+                "swaps_total": self._state.swaps_total,
                 # hierarchical coordination plane: aggregator flush ages +
                 # which members currently report via an aggregator, and the
                 # inbound RPC counters the aggregation win is measured by
@@ -1345,7 +1542,12 @@ class LighthouseServer:
         cards = "".join(
             f"<div class='card'><b>{html.escape(p['replica_id'])}</b>"
             f"<br>step {p['step']} · ws {p['world_size']}"
-            f"<br><code>{html.escape(p['address'])}</code>"
+            + (
+                f" · <b>capacity {p['capacity']:.2f}</b>"
+                if p.get("capacity", 1.0) < 1.0
+                else ""
+            )
+            + f"<br><code>{html.escape(p['address'])}</code>"
             f"<br><a href='/replica/{html.escape(p['replica_id'])}/kill'>kill</a></div>"
             for p in s["participants"]
         )
@@ -1435,6 +1637,7 @@ class LighthouseClient(RpcClient):
         commit_failures: int = 0,
         data: Optional[dict] = None,
         role: int = ROLE_ACTIVE,
+        capacity: float = 1.0,
     ) -> Quorum:
         """Block until a quorum containing this replica is issued (or, for
         ``role=ROLE_SPARE``, until ANY quorum is issued — the spare's live
@@ -1467,16 +1670,23 @@ class LighthouseClient(RpcClient):
                 f"({WIRE_COMPAT_ENV} pins an older version)"
             )
         base = self._quorum_cache if wire_version >= 4 else None
+        has_capacity_tail = wire_version >= 5 and capacity != 1.0
         if wire_version >= 4:
             # v4 tail: role + the delta base this client can apply edits
             # to.  A v3 (or older) server reads the role and ignores the
-            # rest; it can only ever answer with a full snapshot.
-            w.u32(4)
+            # rest; it can only ever answer with a full snapshot.  v5
+            # appends the degraded-capacity fraction, emitted only when
+            # this replica is actually wounded — a full-capacity request
+            # stays byte-identical to v4 (a full-capacity registration is
+            # also how a healed replica advertises its restoration).
+            w.u32(5 if has_capacity_tail else 4)
             w.u8(role)
             w.boolean(base is not None)
             if base is not None:
                 w.i64(base.quorum_id)
                 w.u64(self._quorum_cache_digest)
+            if has_capacity_tail:
+                w.f64(capacity)
         elif role != ROLE_ACTIVE:
             # version-gated v3 tail: active members stay byte-identical to
             # v2 (a legacy or native-tier lighthouse never sees spare
@@ -1511,21 +1721,34 @@ class LighthouseClient(RpcClient):
         timeout: float = 5.0,
         health: Optional[CommHealth] = None,
         warm_step: Optional[int] = None,
+        capacity: Optional[float] = None,
     ) -> None:
         """Heartbeat, optionally carrying a cumulative comm-health summary
-        (straggler detection input) and, under wire v4, a spare warm-step
-        watermark (keeps the lighthouse's promotion-eligibility view fresh
-        at beat cadence).  Idempotent: one reconnect-retry rides out a
-        lighthouse connection blip instead of crashing the sender."""
+        (straggler detection input), a spare warm-step watermark under wire
+        v4 (keeps the lighthouse's promotion-eligibility view fresh at beat
+        cadence), and a degraded-capacity fraction under wire v5 (keeps
+        the wound→swap→evict ladder fresh at beat cadence; emitted only
+        when degraded, so full-capacity beats stay byte-identical to v4).
+        Idempotent: one reconnect-retry rides out a lighthouse connection
+        blip instead of crashing the sender."""
         w = Writer().string(replica_id)
         send_warm = warm_step is not None and manager_quorum_wire_version() >= 4
-        if health is not None or send_warm:
+        send_cap = (
+            capacity is not None
+            and capacity != 1.0
+            and manager_quorum_wire_version() >= 5
+        )
+        if health is not None or send_warm or send_cap:
             w.u8(1 if health is not None else 0)
             if health is not None:
                 health.encode(w)
-        if send_warm:
+        if send_warm or send_cap:
+            w.u8(1 if send_warm else 0)
+            if send_warm:
+                w.i64(warm_step)
+        if send_cap:
             w.u8(1)
-            w.i64(warm_step)
+            w.f64(capacity)
         msg_type, r = self.call(
             MsgType.LH_HEARTBEAT_REQ, w.payload(), timeout, idempotent=True
         )

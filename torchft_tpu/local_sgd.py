@@ -21,6 +21,18 @@ with torch pipelining; leaf groups are the natural jax equivalent).  Backups
 are host numpy (the reference pins them to CPU, ``local_sgd.py:241-253``);
 pseudogradient math runs on host, the outer optimizer step runs through
 optax.
+
+Degraded fleets (wire v5): when the quorum carries wounded replicas, the
+outer reduce both wrappers ride (``Manager.allreduce`` for LocalSGD and the
+legacy DiLoCo path, ``Manager.outer_shard_allreduce`` for the sharded one)
+automatically becomes a capacity-WEIGHTED average — each replica's
+pseudogradient counts by its capacity share, matching the
+capacity-proportional data shard it actually trained on
+(``data.DistributedSampler(capacities=...)``).  Nothing here changes:
+the weighting is a pure pre-scale of each replica's contribution, the
+allgathered wire-format delta stays bit-identical across replicas, and the
+``_OuterShard`` layout is untouched (a wound never bumps ``quorum_id``, so
+no reshard fires; the shard geometry depends on membership, not capacity).
 """
 
 from __future__ import annotations

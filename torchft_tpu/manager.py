@@ -248,6 +248,17 @@ class Manager:
                     f"{WIRE_COMPAT_ENV} on this replica"
                 )
         self._role = role
+        # degraded mode (wire v5): the surviving-device fraction this
+        # replica re-lowered onto (1.0 = full width), advertised on every
+        # quorum registration and — while degraded — on heartbeats;
+        # _relower_pending fences the commit vote between begin_relower()
+        # and complete_relower() so a half-relowered replica never votes
+        # commit; _participant_capacities is the whole quorum's capacity
+        # vector (aligned with sorted replica ids) driving the data-shard
+        # rescale and the weighted outer reduce
+        self._capacity = 1.0
+        self._relower_pending = False
+        self._participant_capacities: List[float] = []
         self._spare_replica_ids: List[str] = []
         self._warm_staged: Optional[tuple] = None
         self._warm_staged_ts = 0.0
@@ -342,6 +353,10 @@ class Manager:
                 warm_step_fn=(
                     (lambda: self._step) if role == "spare" else None
                 ),
+                # degraded capacity rides quorum registrations (every
+                # round) and, while < 1, direct heartbeats — read live so
+                # complete_relower takes effect on the next beat
+                capacity_fn=lambda: self._capacity,
             )
             # idle-priority warm serving: spare chunk fetches yield to live
             # collectives when the communicator exposes a busy probe
@@ -518,6 +533,99 @@ class Manager:
             self._logger.warn(f"outer delta publish failed: {e}")
 
     # ------------------------------------------------------------------
+    # degraded mode (survive in-replica device loss)
+    # ------------------------------------------------------------------
+
+    @property
+    def capacity(self) -> float:
+        """Surviving-device fraction this replica runs at (1.0 = full
+        width).  Advertised to the lighthouse on every quorum registration
+        (and on heartbeats while degraded) as the wire-v5 capacity tail."""
+        return self._capacity
+
+    def participant_capacities(self) -> List[float]:
+        """Per-participant capacity fractions of the current quorum,
+        aligned with the sorted replica-id order (empty on pre-v5 peers or
+        before the first quorum).  Callers must hold a completed quorum
+        (``wait_quorum``) — the data-shard rescale path does."""
+        return list(self._participant_capacities)
+
+    def begin_relower(self) -> None:
+        """Mark the start of a degraded re-lower (device loss detected;
+        inner mesh about to be rebuilt on the survivors).  Between here and
+        :meth:`complete_relower` every commit vote is forced False: a
+        half-relowered replica holds inner state that is neither the old
+        nor the new layout, and a commit landing in that window would fork
+        it from the fleet.  Idempotent; crash-safe by construction (a
+        replica that dies mid-relower simply never voted commit)."""
+        self._relower_pending = True
+
+    def complete_relower(self, capacity: float) -> None:
+        """Finish a degraded re-lower: the inner mesh is consistent again
+        on the surviving devices and this replica now runs at ``capacity``
+        (0 < capacity <= 1).  Lifts the commit fence and advertises the new
+        fraction on the next quorum registration/heartbeat.  Also the
+        restore path: ``complete_relower(1.0)`` after the wounded devices
+        heal re-admits a swapped-out replica."""
+        if not 0.0 < capacity <= 1.0:
+            raise ValueError(
+                f"capacity must be in (0, 1], got {capacity!r}"
+            )
+        if capacity < 1.0 and self._manager_server is not None and not hasattr(
+            self._manager_server, "_capacity_fn"
+        ):
+            # the C++ sidecar has no capacity plumbing: registering
+            # full-width while actually degraded would make peers weight
+            # this replica's starved contribution at full strength —
+            # refuse loudly (docs/operations.md §16 fallback matrix)
+            raise RuntimeError(
+                "degraded mode requires the Python control plane; this "
+                "replica's manager server does not advertise capacity"
+            )
+        self._capacity = capacity
+        self._relower_pending = False
+        self._logger.info(
+            f"re-lower complete: running at capacity {capacity:.3f}"
+        )
+
+    def _capacity_weights_engaged(self) -> bool:
+        """True when the outer reduce must be capacity-weighted this step.
+        A pure function of quorum facts (the capacity vector and the
+        participant count), so every rank reaches the same verdict — a
+        split decision would fork the divisor across the fleet.  Weighted
+        mode requires participation to cover the whole quorum (sync-quorum
+        rounds, or async rounds with nobody healing): with healers
+        excluded, capacity shares normalized over all members would
+        mis-scale the average, so those rounds fall back to the uniform
+        1/num_participants divisor."""
+        caps = self._participant_capacities
+        return bool(
+            caps
+            and any(c < 1.0 for c in caps)
+            and sum(caps) > 0.0
+            and self._participating_replica_world_size == len(caps)
+        )
+
+    def _own_capacity_weight(self) -> float:
+        """This replica's normalized capacity share w_i = cap_i / Σ cap
+        under the current quorum (0.0 when not participating).  Only
+        meaningful when :meth:`_capacity_weights_engaged` is True."""
+        caps = self._participant_capacities
+        rank = self._participating_replica_rank
+        if rank is None or not 0 <= rank < len(caps):
+            return 0.0
+        return caps[rank] / sum(caps)
+
+    def _capacity_weight_scale(self) -> Optional[float]:
+        """Pre-scale factor turning the standard ``sum / num_participants``
+        average into the capacity-weighted average: ``w_i × N`` applied to
+        this replica's contribution before the collective, so the shared
+        post-division yields ``Σ w_i · g_i``.  None when unweighted."""
+        if not self._capacity_weights_engaged():
+            return None
+        return self._own_capacity_weight() * self.num_participants()
+
+    # ------------------------------------------------------------------
     # error funnel
     # ------------------------------------------------------------------
 
@@ -671,6 +779,13 @@ class Manager:
         # registered spares this round (v3; empty on legacy peers) gate the
         # active-side warm channels
         self._spare_replica_ids = list(quorum.spare_replica_ids)
+        # per-participant capacities (v5; empty on legacy peers): the
+        # weighted-outer-reduce and data-shard-rescale inputs — refreshed
+        # every round even without a membership change, since a wound
+        # never bumps quorum_id by itself
+        self._participant_capacities = list(
+            getattr(quorum, "participant_capacities", None) or []
+        )
 
         quorum_id = quorum.quorum_id
         replica_rank = quorum.replica_rank
@@ -1059,6 +1174,15 @@ class Manager:
                 data = np.zeros_like(data)
             else:
                 data = [np.zeros_like(a) for a in data]
+        elif (scale := self._capacity_weight_scale()) is not None:
+            # degraded fleet: pre-scale this replica's contribution by
+            # w_i × N so the shared 1/N post-division yields the
+            # capacity-weighted average Σ w_i·g_i — matching the
+            # capacity-proportional data shards each replica processed.
+            # The collective's summed bytes stay identical on every rank,
+            # so replicas never fork.  Integer grads are left unweighted
+            # (fractional scaling would truncate them to garbage).
+            data = _scale_contribution(data, scale)
 
         try:
             if should_quantize:
@@ -1120,6 +1244,11 @@ class Manager:
         if not self.is_participating():
             q_in = np.zeros_like(q)
             s_in = np.zeros_like(scales)
+        elif (scale := self._capacity_weight_scale()) is not None:
+            # weighted average on an already-quantized stream: the int8
+            # payload is untouchable, but dequant = q × scale — so the
+            # capacity weight rides the rowwise scales
+            s_in = (np.asarray(scales, np.float32) * np.float32(scale))
 
         fut: concurrent.futures.Future = concurrent.futures.Future()
 
@@ -1185,6 +1314,16 @@ class Manager:
         if not self.is_participating():
             flat = np.zeros_like(flat)
 
+        # degraded fleet: the sharded outer sync runs as a WEIGHTED sum —
+        # every rank pre-scales its pseudo-gradient by its normalized
+        # capacity share and the division drops out (weights sum to 1).
+        # The engage decision is a pure function of quorum facts, so the
+        # whole fleet flips together; the allgathered wire-format delta
+        # stays bit-identical across replicas either way.
+        weight: Optional[float] = None
+        if self._capacity_weights_engaged():
+            weight = self._own_capacity_weight() if self.is_participating() else 0.0
+
         from torchft_tpu.collectives import outer_sharded_sync
         from torchft_tpu.quantization import quant_kind
 
@@ -1203,6 +1342,7 @@ class Manager:
                     should_quantize=should_quantize,
                     kind=kind or "int8",
                     timings=tm,
+                    weight=weight,
                     # delta-tap: stage the (replica-identical) delta bytes
                     # for the spare feed; published only on a committed vote
                     tap=(
@@ -1285,6 +1425,18 @@ class Manager:
 
         if self._healing:
             self._apply_pending_state_dict()
+
+        if self._relower_pending:
+            # degraded re-lower in flight: inner state is mid-transition
+            # between device layouts — committing now would fork this
+            # replica from the fleet (and a crash here must read as "never
+            # voted commit", which funneling to a False vote guarantees)
+            self.report_error(
+                RuntimeError(
+                    "degraded re-lower in progress; refusing to commit a "
+                    "half-relowered step"
+                )
+            )
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None
@@ -1403,6 +1555,23 @@ class Manager:
     @_logger.setter
     def _logger(self, value: "_ManagerLogger") -> None:
         self._logger_obj = value
+
+
+def _scale_contribution(
+    data: Union[np.ndarray, List[np.ndarray]], scale: float
+) -> Union[np.ndarray, List[np.ndarray]]:
+    """Out-of-place capacity-weight pre-scale of a gradient contribution
+    (same dtype-preservation contract as :func:`_div`; integer arrays pass
+    through unscaled — fractional weights would floor them to noise)."""
+
+    def _one(a: np.ndarray) -> np.ndarray:
+        if np.issubdtype(a.dtype, np.integer):
+            return a
+        return (a * scale).astype(a.dtype)
+
+    if isinstance(data, np.ndarray):
+        return _one(data)
+    return [_one(a) for a in data]
 
 
 def _div(a: np.ndarray, n: int) -> np.ndarray:

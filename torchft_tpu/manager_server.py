@@ -127,6 +127,7 @@ def compute_quorum_results(
             is_spare=True,
             spare_replica_ids=spare_ids,
             all_manager_addresses=[p.address for p in participants],
+            participant_capacities=[p.capacity for p in participants],
         )
 
     max_step = max(p.step for p in participants)
@@ -209,6 +210,10 @@ def compute_quorum_results(
         all_manager_addresses=(
             [p.address for p in participants] if spare_ids else []
         ),
+        # degraded-mode (wire v5): per-participant capacities, aligned with
+        # ``replica_ids`` (sorted participant order) — the data-shard
+        # rescale and weighted-outer-reduce inputs every rank needs
+        participant_capacities=[p.capacity for p in participants],
     )
 
 
@@ -231,6 +236,7 @@ class ManagerServer:
         role: int = ROLE_ACTIVE,
         warm_fn: Optional[Callable[[], Optional[object]]] = None,
         warm_step_fn: Optional[Callable[[], int]] = None,
+        capacity_fn: Optional[Callable[[], float]] = None,
     ) -> None:
         self._replica_id = replica_id
         self._lighthouse_addr = lighthouse_addr
@@ -259,6 +265,13 @@ class ManagerServer:
         # the lighthouse's promotion-eligibility view stays fresh at beat
         # cadence, not quorum-RPC re-registration cadence
         self._warm_step_fn = warm_step_fn
+        # degraded-capacity provider (wire v5): the surviving-device
+        # fraction this replica re-lowered onto (1.0 = full width).  Rides
+        # the quorum registration every round and — while degraded — each
+        # direct heartbeat, so the lighthouse's wound→swap→evict ladder
+        # reacts at beat cadence.  Errors are swallowed like health_fn:
+        # the probe must never kill the beat.
+        self._capacity_fn = capacity_fn
         # hierarchical coordination plane: beats route through the zone
         # aggregator named by TORCHFT_AGG_ADDR (read live each beat) and
         # fall back to direct lighthouse beats on aggregator death.
@@ -389,6 +402,7 @@ class ManagerServer:
                     warm_step = int(self._warm_step_fn())
                 except Exception:  # noqa: BLE001 — probe must not kill beats
                     warm_step = -1
+            capacity = self._capacity()
             sent = False
             from torchft_tpu.wire import manager_quorum_wire_version
 
@@ -467,6 +481,7 @@ class ManagerServer:
                         self._replica_id,
                         health=health,
                         warm_step=warm_step if warm_step >= 0 else None,
+                        capacity=capacity if capacity != 1.0 else None,
                     )
                     # ftlint: ignore[thread-safety] — single-writer counter
                     self._beats_direct += 1
@@ -499,6 +514,18 @@ class ManagerServer:
             client.close()
         if agg_client is not None:
             agg_client.close()
+
+    def _capacity(self) -> float:
+        """This replica's current degraded-capacity fraction (1.0 when no
+        provider is wired or the probe fails — full width is the safe
+        default: it never triggers the swap/evict rungs)."""
+        if self._capacity_fn is None:
+            return 1.0
+        try:
+            cap = float(self._capacity_fn())
+        except Exception:  # noqa: BLE001 — probe must not kill beats/quorums
+            return 1.0
+        return min(1.0, max(0.0, cap)) if cap > 0.0 else 1.0
 
     def coord_stats(self) -> Dict[str, int]:
         """Coordination-plane beat routing counters (observability: the
@@ -770,6 +797,7 @@ class ManagerServer:
                 shrink_only=shrink_only,
                 commit_failures=commit_failures,
                 role=self.role,
+                capacity=self._capacity(),
             )
             self._participants[group_rank] = member
             gen = self._quorum_gen
@@ -877,6 +905,7 @@ class ManagerServer:
                     shrink_only=requester.shrink_only,
                     commit_failures=requester.commit_failures,
                     role=self.role,
+                    capacity=self._capacity(),
                 )
                 break
             except (OSError, TimeoutError, WireError) as e:

@@ -486,6 +486,7 @@ class CppManagerServer:
         role: int = 0,
         warm_fn: Optional[object] = None,
         warm_step_fn: Optional[object] = None,
+        capacity_fn: Optional[object] = None,
     ) -> None:
         import socket
 
@@ -497,7 +498,12 @@ class CppManagerServer:
         # beat-carried spare warm watermark) likewise: the C++ sidecar
         # cannot host a spare or feed one — spare roles require the Python
         # tier (Manager(role="spare") refuses a native server_cls).
-        del health_fn, warm_fn, warm_step_fn
+        # capacity_fn (the wire-v5 degraded-capacity fraction) likewise:
+        # the C++ sidecar always registers full-width — a degraded-mode
+        # replica needs the Python control plane (Manager refuses to
+        # complete a re-lower on a native server_cls; docs/operations.md
+        # §16 has the fallback matrix entry).
+        del health_fn, warm_fn, warm_step_fn, capacity_fn
         if role != 0:
             raise ValueError(
                 "CppManagerServer does not support the SPARE role; use the "

@@ -236,6 +236,24 @@ class HSDPTrainer:
             state["opt_state"], self.holder["opt_state"]
         )
 
+    def relower(
+        self, surviving_devices: Any, plan: Any = None
+    ) -> Any:
+        """Degraded-mode re-lower onto the surviving devices (device loss
+        WITHOUT replica death): rebuild the mesh, reshard params +
+        optimizer state, recompile the steps, and fence the commit vote
+        across the transition via ``Manager.begin_relower`` /
+        ``complete_relower`` — a crash mid-reshard reads as "never voted
+        commit".  Returns the applied
+        :class:`~torchft_tpu.parallel.degraded.DegradedPlan` (whose
+        ``capacity`` the manager now advertises on the wire-v5 tail)."""
+        from torchft_tpu.parallel.degraded import relower_hsdp_trainer
+
+        self.manager.begin_relower()
+        plan = relower_hsdp_trainer(self, surviving_devices, plan)
+        self.manager.complete_relower(plan.capacity)
+        return plan
+
     def train_step(self, batch: Any) -> Tuple[float, bool]:
         """One fault-tolerant step; returns (loss, committed)."""
         self.manager.start_quorum()

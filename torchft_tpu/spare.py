@@ -30,6 +30,17 @@ lives in ``manager.py``/``manager_server.py``; a spare is a pure consumer
 and a dying or poisoned spare can never stall or fork the active fleet —
 every warm RPC is served outside the heal path, the delta feed ring is
 bounded, and the fleet's quorum math never counts a spare.
+
+Degraded-mode swaps (wire v5, ``docs/operations.md`` §16): the lighthouse
+may promote a spare not only over a DEATH but over a WOUND — a replica
+that lost in-replica devices and re-lowered at reduced capacity trades
+places with a full-width warm spare in one membership edit
+(``TORCHFT_DEGRADED_SWAP``).  Nothing changes on this side: the promotion
+handshake below is identical whether the replaced member died or was
+swapped out (the spare is seated by the same ``_promote_spares``
+computation and adopts the quorum through the same fast path); a spare is
+always full-width by construction, so it registers at capacity 1.0 and
+its promotion restores the fleet's full data shard.
 """
 
 from __future__ import annotations

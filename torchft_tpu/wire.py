@@ -65,16 +65,23 @@ _CONNECT_BACKOFF_BASE_S = 0.1
 # grows a delta-base tail (the requester's last-seen quorum digest, so the
 # lighthouse can answer with a LH_QUORUM_DELTA_RESP instead of the full
 # membership), heartbeats may carry a spare warm-step tail, and the
-# aggregated-beat messages (AGG_BEAT / LH_AGG_BEAT) exist at all.  v1
-# decoders ignore trailing bytes and v2+ decoders treat their absence as
-# "no striping/spare/delta info", so mixed fleets interoperate during a
-# rolling upgrade; pin TORCHFT_WIRE_COMPAT=1/2/3 on upgraded processes
-# until every peer understands the newer version (a v3 pin keeps every
-# frame byte-identical to the pre-v4 protocol).  The v3 spare fields are
-# additionally emitted only when spare content EXISTS, so a spare-free
-# fleet stays byte-for-byte on the v2 layout, and a delta response is only
-# ever sent to a requester that advertised a v4 delta base.
-MANAGER_QUORUM_WIRE_VERSION = 4
+# aggregated-beat messages (AGG_BEAT / LH_AGG_BEAT) exist at all.  v5 adds
+# degraded-mode capacity: a replica that lost in-replica devices and
+# re-lowered onto the survivors advertises a capacity fraction (0, 1] on
+# its quorum registration and its heartbeats, the Quorum broadcast carries
+# per-participant capacities, and MGR_QUORUM_RESP fans them out to every
+# rank (data-shard rescale + weighted outer reduce inputs).  v1 decoders
+# ignore trailing bytes and v2+ decoders treat their absence as "no
+# striping/spare/delta/capacity info", so mixed fleets interoperate during
+# a rolling upgrade; pin TORCHFT_WIRE_COMPAT=1/2/3/4 on upgraded processes
+# until every peer understands the newer version (a v4 pin keeps every
+# frame byte-identical to the pre-v5 protocol).  The v3 spare fields are
+# additionally emitted only when spare content EXISTS (a spare-free fleet
+# stays byte-for-byte on the v2 layout), the v5 capacity fields only when
+# some replica is actually degraded (a full-capacity fleet stays
+# byte-for-byte on the v4 layout), and a delta response is only ever sent
+# to a requester that advertised a v4 delta base.
+MANAGER_QUORUM_WIRE_VERSION = 5
 WIRE_COMPAT_ENV = "TORCHFT_WIRE_COMPAT"
 
 # QuorumMember roles (wire v3).  ACTIVE members count toward min_replicas /
@@ -394,6 +401,12 @@ class QuorumMember:
     # rides as a version-gated tail on the messages that carry members —
     # see ROLE_ACTIVE/ROLE_SPARE above.
     role: int = ROLE_ACTIVE
+    # Degraded-mode capacity fraction (wire v5), also a version-gated tail:
+    # 1.0 = full width; a replica that lost devices and re-lowered onto the
+    # survivors advertises the surviving fraction.  Inputs to data-shard
+    # rescale, the weighted outer reduce, and the lighthouse's
+    # wound→swap→evict policy ladder.
+    capacity: float = 1.0
 
     def encode(self, w: Writer) -> None:
         (
@@ -471,7 +484,14 @@ class Quorum:
     plane but are NOT participants — they never count toward membership,
     never affect ``quorum_id``, and a v1/v2 decoder never sees them (it
     stops after the participants).  The tail is emitted only when spares
-    exist, so spare-free quorums stay byte-identical to v2."""
+    exist, so spare-free quorums stay byte-identical to v2.
+
+    Per-participant capacities (wire v5) ride a second tail AFTER the
+    spares tail, emitted only when some participant is actually degraded
+    (full-capacity quorums stay byte-identical to v4); when emitted, the
+    spares tail is always emitted too (possibly with zero spares) so v3/v4
+    decoders — which read the first tail as spares — stop cleanly before
+    the capacity bytes."""
 
     quorum_id: int
     participants: List[QuorumMember] = field(default_factory=list)
@@ -482,11 +502,26 @@ class Quorum:
         w.i64(self.quorum_id).f64(self.created).u32(len(self.participants))
         for p in self.participants:
             p.encode(w)
-        if self.spares and manager_quorum_wire_version() >= 3:
+        wire_version = manager_quorum_wire_version()
+        has_capacity_tail = wire_version >= 5 and any(
+            p.capacity != 1.0 for p in self.participants
+        )
+        # the capacity tail implies the spares tail (possibly empty): v3/v4
+        # decoders read the first tail as spares and stop before the
+        # capacity bytes
+        has_spare_tail = wire_version >= 3 and (
+            bool(self.spares) or has_capacity_tail
+        )
+        if has_spare_tail:
             w.u32(3)
             w.u32(len(self.spares))
             for s in self.spares:
                 s.encode(w)
+        if has_capacity_tail:
+            w.u32(5)
+            w.u32(len(self.participants))
+            for p in self.participants:
+                w.f64(p.capacity)
 
     @staticmethod
     def decode(r: Reader) -> "Quorum":
@@ -502,6 +537,10 @@ class Quorum:
             out.spares = [QuorumMember.decode(r) for _ in range(r.u32())]
             for s in out.spares:
                 s.role = ROLE_SPARE
+        if not r.done() and r.u32() >= 5:
+            capacities = [r.f64() for _ in range(r.u32())]
+            for p, cap in zip(out.participants, capacities):
+                p.capacity = cap
         return out
 
 
@@ -510,8 +549,14 @@ def _member_sig(m: QuorumMember) -> tuple:
     wire-layout fields only.  ``role`` is deliberately excluded — it never
     rides the fixed layout (which list a member appears in IS its role), so
     including it would make server-side digests (which may hold a promoted
-    spare's original role) disagree with a client's decoded view."""
-    return (
+    spare's original role) disagree with a client's decoded view.
+
+    ``capacity`` (wire v5) is appended ONLY when degraded: a full-capacity
+    member's sig is byte-for-byte what a v4 peer computes, so mixed v4/v5
+    fleets keep agreeing on digests (and riding deltas) until somebody is
+    actually wounded — at which point the v4 peer's digest mismatch
+    degrades it to full snapshots, never to a wrong membership view."""
+    sig = (
         m.replica_id,
         m.address,
         m.store_address,
@@ -521,13 +566,16 @@ def _member_sig(m: QuorumMember) -> tuple:
         m.commit_failures,
         m.data,
     )
+    return sig if m.capacity == 1.0 else sig + (m.capacity,)
 
 
 def _member_static_sig(m: QuorumMember) -> tuple:
     """Like :func:`_member_sig` minus the per-round movers (step,
     commit_failures) — members equal under this sig ride a quorum delta as
-    a compact per-index step update instead of a full record."""
-    return (
+    a compact per-index step update instead of a full record.  ``capacity``
+    rides here too (conditionally, like :func:`_member_sig`): a capacity
+    change must travel as a full upsert, never be lost in a step update."""
+    sig = (
         m.replica_id,
         m.address,
         m.store_address,
@@ -535,6 +583,7 @@ def _member_static_sig(m: QuorumMember) -> tuple:
         m.shrink_only,
         m.data,
     )
+    return sig if m.capacity == 1.0 else sig + (m.capacity,)
 
 
 def quorum_digest(quorum: "Quorum") -> int:
@@ -617,7 +666,13 @@ class QuorumDelta:
     commit_failures)`` triples against the base's canonical sorted order.
     The receiver applies the edit to its cached base and verifies
     ``new_digest`` — a mismatch is a protocol error, and the client falls
-    back to a full snapshot on its next request."""
+    back to a full snapshot on its next request.
+
+    Upserted members' degraded capacities (wire v5) ride a version-gated
+    tail aligned with ``upserts`` (a capacity change always travels as a
+    full upsert — ``_member_static_sig`` includes capacity); emitted only
+    when some upsert is actually degraded, so full-capacity deltas stay
+    byte-identical to v4."""
 
     quorum_id: int = 0
     created: float = 0.0
@@ -649,6 +704,13 @@ class QuorumDelta:
         w.u32(len(self.spare_upserts))
         for s in self.spare_upserts:
             s.encode(w)
+        if manager_quorum_wire_version() >= 5 and any(
+            m.capacity != 1.0 for m in self.upserts
+        ):
+            w.u32(5)
+            w.u32(len(self.upserts))
+            for m in self.upserts:
+                w.f64(m.capacity)
 
     @staticmethod
     def decode(r: Reader) -> "QuorumDelta":
@@ -670,6 +732,10 @@ class QuorumDelta:
         out.spare_upserts = [QuorumMember.decode(r) for _ in range(r.u32())]
         for s in out.spare_upserts:
             s.role = ROLE_SPARE
+        if not r.done() and r.u32() >= 5:
+            capacities = [r.f64() for _ in range(r.u32())]
+            for m, cap in zip(out.upserts, capacities):
+                m.capacity = cap
         return out
 
 
@@ -813,6 +879,12 @@ class ManagerQuorumResult:
     is_spare: bool = False
     spare_replica_ids: List[str] = field(default_factory=list)
     all_manager_addresses: List[str] = field(default_factory=list)
+    # -- v5 (degraded-mode capacity) -----------------------------------------
+    # Per-participant capacity fractions aligned with ``replica_ids`` so
+    # every rank can rescale its data shard and weight the outer reduce.
+    # Emitted only when some participant is actually degraded — a
+    # full-capacity fleet stays byte-for-byte on the v4 layout.
+    participant_capacities: List[float] = field(default_factory=list)
 
     def heal_sources(self) -> List[Tuple[int, str]]:
         """(replica_rank, manager_address) of every peer able to serve this
@@ -845,11 +917,16 @@ class ManagerQuorumResult:
         for rid in self.replica_ids:
             w.string(rid)
         wire_version = manager_quorum_wire_version()
+        has_capacity_tail = wire_version >= 5 and any(
+            c != 1.0 for c in self.participant_capacities
+        )
         has_spare_tail = wire_version >= 3 and (
-            self.is_spare or self.spare_replica_ids
+            self.is_spare or bool(self.spare_replica_ids) or has_capacity_tail
         )
         if wire_version >= 2:
-            w.u32(3 if has_spare_tail else 2)
+            w.u32(
+                5 if has_capacity_tail else 3 if has_spare_tail else 2
+            )
             w.u32(len(self.recover_src_replica_ranks))
             for rank in self.recover_src_replica_ranks:
                 w.i64(rank)
@@ -867,6 +944,10 @@ class ManagerQuorumResult:
             w.u32(len(self.all_manager_addresses))
             for addr in self.all_manager_addresses:
                 w.string(addr)
+        if has_capacity_tail:
+            w.u32(len(self.participant_capacities))
+            for cap in self.participant_capacities:
+                w.f64(cap)
 
     @staticmethod
     def decode(r: Reader) -> "ManagerQuorumResult":
@@ -901,6 +982,10 @@ class ManagerQuorumResult:
                 out.spare_replica_ids = [r.string() for _ in range(r.u32())]
                 out.all_manager_addresses = [
                     r.string() for _ in range(r.u32())
+                ]
+            if tail_version >= 5:
+                out.participant_capacities = [
+                    r.f64() for _ in range(r.u32())
                 ]
         return out
 
