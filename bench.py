@@ -1294,6 +1294,98 @@ def _run_coord_phase(num_replicas: int) -> Dict[str, Any]:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _run_obs_phase() -> Dict[str, Any]:
+    """Observability-overhead gate (ISSUE 14): the flight recorder + trace
+    spans must cost <= 1% of step time when fully enabled.
+
+    Two measurements, combined as a ratio:
+
+    - **step time**: a synthetic step (a fixed numpy matmul workload sized
+      to a few milliseconds — conservative: a real train step is orders of
+      magnitude longer, making the same absolute obs cost proportionally
+      smaller), median over ``TPUFT_BENCH_OBS_STEPS``.
+    - **obs cost per step**: the per-step event/span pattern the real
+      protocol emits (~14 events + ~8 spans: quorum start/adopt, lane
+      windows, vote/commit) run WITHOUT the workload, thousands of
+      repetitions, enabled minus disabled — the marginal cost of turning
+      recorder + spans on, measured to sub-microsecond resolution instead
+      of differencing two multi-millisecond legs whose ambient jitter
+      would swamp a <1% effect.
+
+    ``obs_overhead_frac = obs_cost_per_step / step_time``."""
+    import numpy as np
+
+    from torchft_tpu.obs import spans as obs_spans
+    from torchft_tpu.obs.flight import FlightEvent, FlightRecorder
+
+    steps = int(os.environ.get("TPUFT_BENCH_OBS_STEPS", "") or 40)
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=(896, 896)).astype(np.float32)
+    b = rng.normal(size=(896, 896)).astype(np.float32)
+
+    def obs_pattern(rec: FlightRecorder, i: int) -> None:
+        span = obs_spans.span
+        rec.set_context(step=i, quorum_id=1)
+        rec.record(FlightEvent.QUORUM_START, step=i)
+        with span("manager::quorum_rpc", step=i):
+            rec.record(FlightEvent.QUORUM_ADOPT, step=i, world=3)
+        with span("comm::op", epoch=1):
+            for lane in range(4):
+                with span("comm::lane_window", lane=lane):
+                    rec.record(
+                        FlightEvent.COMM_CONFIGURE, rank=0, world=3, lanes=4
+                    )
+        with span("manager::fence", step=i):
+            rec.record(FlightEvent.COMMIT_FENCE, step=i)
+        for _ in range(6):  # heal/lane/chaos-shaped background events
+            rec.record(FlightEvent.LANE_RECONNECT, peer=1, lane=0)
+        rec.record(FlightEvent.COMMIT_VOTE, step=i, local=True)
+        with span("manager::should_commit", step=i):
+            rec.record(FlightEvent.COMMIT_RESULT, step=i, committed=True)
+
+    def measure_pattern(rec: FlightRecorder, spans_on: bool, reps: int) -> float:
+        obs_spans.configure(spans_on)
+        for i in range(50):  # warm caches + the allocator
+            obs_pattern(rec, i)
+        t0 = time.perf_counter()
+        for i in range(reps):
+            obs_pattern(rec, i)
+        return (time.perf_counter() - t0) / reps
+
+    saved_enabled = obs_spans._enabled
+    try:
+        # the step the tax is measured against (median beats jitter)
+        times = []
+        for _ in range(max(8, steps)):
+            t0 = time.perf_counter()
+            _ = a @ b
+            _ = a @ b
+            times.append(time.perf_counter() - t0)
+        t_step = float(np.median(times))
+
+        off_rec = FlightRecorder("bench_obs_off", cap=0)
+        on_rec = FlightRecorder("bench_obs_on", cap=4096)
+        reps = 2000
+        t_pat_off = measure_pattern(off_rec, spans_on=False, reps=reps)
+        t_pat_on = measure_pattern(on_rec, spans_on=True, reps=reps)
+        obs_cost = max(0.0, t_pat_on - t_pat_off)
+        frac = obs_cost / t_step if t_step > 0 else 0.0
+        return {
+            "obs_overhead_frac": round(frac, 5),
+            "step_ms": round(t_step * 1e3, 4),
+            "obs_cost_us_per_step": round(obs_cost * 1e6, 3),
+            "pattern_us_disabled": round(t_pat_off * 1e6, 3),
+            "pattern_us_enabled": round(t_pat_on * 1e6, 3),
+            "events_per_step": 14,
+            "spans_per_step": 8,
+            "events_recorded": len(on_rec),
+            "spans_recorded": len(obs_spans.snapshot()),
+        }
+    finally:
+        obs_spans.configure(saved_enabled)
+        obs_spans.clear()
+
+
 _PARTIAL: Dict[str, Any] = {}
 # overridable so a recovery subprocess (see _try_tpu_phase_a) never
 # clobbers the parent run's streaming artifact
@@ -1714,6 +1806,22 @@ def main() -> None:
             lighthouse_cpu_frac=coord.get("lighthouse_cpu_frac"),
         )
 
+    obs: Dict[str, Any] = {}
+    if not os.environ.get("TPUFT_BENCH_SKIP_OBS"):
+        # observability-overhead gate (ISSUE 14): pure host-side micro
+        # phase, seconds regardless of platform — runs even when the fleet
+        # block was skipped
+        try:
+            obs = _run_obs_phase()
+        except Exception as e:  # noqa: BLE001 — a failed phase is a
+            # recorded fact, never a lost artifact
+            obs = {"error": f"{type(e).__name__}: {e}"}
+        print(f"bench: obs overhead {obs}", file=sys.stderr)
+        # the headline key streams TOP-LEVEL the moment the phase lands
+        _emit_partial(
+            obs=obs, obs_overhead_frac=obs.get("obs_overhead_frac")
+        )
+
     if ratio is None:
         # fleet phases unusable: fall back to the ws=1 protocol ratio so the
         # bench always reports something honest
@@ -1755,6 +1863,8 @@ def main() -> None:
         out["diloco"] = diloco
     if coord:
         out["coord"] = coord
+    if obs:
+        out["obs"] = obs
     if single_tpu:
         out["single_tpu"] = single_tpu
     # FULL detail goes to bench_out.json; stdout gets ONE compact headline
@@ -1803,6 +1913,9 @@ def main() -> None:
         "coord_p99_quorum_latency_s": coord.get("p99_quorum_latency_s"),
         "lighthouse_cpu_frac": coord.get("lighthouse_cpu_frac"),
         "coord_rpc_reduction": coord.get("rpc_reduction_vs_direct"),
+        # ISSUE-14 observability plane: recorder+spans fully enabled must
+        # cost <= 1% step time (the obs phase's measured fraction)
+        "obs_overhead_frac": obs.get("obs_overhead_frac"),
         "quant_device_reduce": qdr_active,
         "detail": "bench_out.json",
     }

@@ -220,6 +220,17 @@ uint64_t tpuft_comm_lane_stats(void* h, uint64_t* tx, uint64_t* rx,
   return comm->lane_stats(tx, rx, stalls, cap);
 }
 
+// consume-drain of the C-side flight-recorder ring (fixed slots recording
+// the epoch lifecycle): fills up to `cap` events oldest-first and returns
+// the count.  obs/flight.py merges the drained events into the Python
+// replica dump (the fleet postmortem view spans both tiers).
+uint64_t tpuft_comm_flight_drain(void* h, uint64_t* seqs, double* ts,
+                                 uint32_t* evs, int64_t* a, int64_t* b,
+                                 uint64_t cap) {
+  auto* comm = static_cast<tpuft::Communicator*>(h);
+  return comm->flight_drain(seqs, ts, evs, a, b, cap);
+}
+
 int tpuft_comm_barrier(void* h) {
   auto* comm = static_cast<tpuft::Communicator*>(h);
   return guarded([&] { comm->barrier(); });

@@ -21,6 +21,7 @@
 #include <sys/uio.h>
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <cctype>
 #include <chrono>
@@ -745,6 +746,26 @@ constexpr uint64_t kLaneHelloFlag = uint64_t(1) << 63;
 // window; mixed-tier meshes now pin this).
 constexpr uint64_t kRingReduceTagBase = 30000;
 
+// Flight-recorder event ids, mirror of the data-plane block of
+// obs/flight.py FlightEvent (the ftlint native-mirror checker pins every
+// kFlight* value against the Python enum).  The native tier records its
+// epoch lifecycle into a fixed-slot ring drained into the Python dump via
+// tpuft_comm_flight_drain.
+constexpr uint32_t kFlightCommConfigure = 20;
+constexpr uint32_t kFlightCommAbort = 21;
+constexpr size_t kFlightRingSlots = 256;
+
+// one C-side flight event: monotonic stamp (steady_clock seconds — the
+// same CLOCK_MONOTONIC base as Python time.monotonic() on Linux) plus two
+// small integer payload fields (rank/world for configure)
+struct FlightSlot {
+  uint64_t seq = 0;
+  double t = 0.0;
+  uint32_t ev = 0;
+  int64_t a = 0;
+  int64_t b = 0;
+};
+
 // Per-epoch IO state: the pacer, the per-lane counters, and the lane
 // config they index.  Ops snapshot ONE shared_ptr at entry — configure()
 // swaps in a fresh instance while a superseded op thread may still be
@@ -881,6 +902,8 @@ class Communicator {
       rank_ = rank;
       world_size_ = world_size;
       aborted_ = false;
+      flight_epochs_.fetch_add(1);
+      flight_record(kFlightCommConfigure, rank, world_size);
     };
     if (world_size <= 1) {
       publish({});
@@ -1023,10 +1046,50 @@ class Communicator {
     // Shut sockets down (don't close): an op thread may be mid-IO on these
     // fds; shutdown unblocks its IO with errors while keeping fd numbers
     // valid.  close happens at destruction.
-    aborted_ = true;
+    // flight: record the transition once per live epoch (configure() calls
+    // abort() to supersede, so a bare flag write would log boot noise)
+    if (!aborted_.exchange(true) && flight_epochs_.load() > 0)
+      flight_record(kFlightCommAbort, 0, 0);
     std::lock_guard<std::mutex> lock(state_mu_);
     for (auto& [peer, fds] : peers_)
       for (int fd : fds) ::shutdown(fd, SHUT_RDWR);
+  }
+
+  // -- flight recorder (C-side fixed-slot ring; obs/flight.py merges it) ---
+
+  void flight_record(uint32_t ev, int64_t a, int64_t b) {
+    std::lock_guard<std::mutex> lock(flight_mu_);
+    FlightSlot& slot = flight_[flight_seq_ % kFlightRingSlots];
+    slot.seq = flight_seq_++;
+    slot.t = std::chrono::duration<double>(
+                 std::chrono::steady_clock::now().time_since_epoch())
+                 .count();
+    slot.ev = ev;
+    slot.a = a;
+    slot.b = b;
+  }
+
+  // Consume-drain the ring oldest-first into the caller's arrays (up to
+  // `cap` events); already-drained and overwritten slots are skipped, so
+  // repeated drains across dumps never duplicate an event.  Returns the
+  // number of events copied.
+  size_t flight_drain(uint64_t* seqs, double* ts, uint32_t* evs, int64_t* a,
+                      int64_t* b, size_t cap) {
+    std::lock_guard<std::mutex> lock(flight_mu_);
+    uint64_t oldest =
+        flight_seq_ > kFlightRingSlots ? flight_seq_ - kFlightRingSlots : 0;
+    uint64_t start = std::max(flight_drained_, oldest);
+    size_t n = 0;
+    for (uint64_t s = start; s < flight_seq_ && n < cap; ++s, ++n) {
+      const FlightSlot& slot = flight_[s % kFlightRingSlots];
+      seqs[n] = slot.seq;
+      ts[n] = slot.t;
+      evs[n] = slot.ev;
+      a[n] = slot.a;
+      b[n] = slot.b;
+    }
+    flight_drained_ = start + n;
+    return n;
   }
 
   void close_peers() {
@@ -1916,6 +1979,14 @@ class Communicator {
   std::shared_ptr<LanePool> pool_;
   IoPtr io_;
   std::vector<int> graveyard_;
+  // epochs ever published (abort() only records a flight event once a
+  // real epoch existed — configure()'s supersede-abort at boot is noise)
+  std::atomic<int64_t> flight_epochs_{0};
+  // guards flight_/flight_seq_/flight_drained_
+  std::mutex flight_mu_;
+  std::array<FlightSlot, kFlightRingSlots> flight_;
+  uint64_t flight_seq_ = 0;
+  uint64_t flight_drained_ = 0;
 };
 
 }  // namespace tpuft

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Merge per-replica flight dumps (+ span files) into one fleet timeline.
+
+Each replica's flight recorder dumps ``flight_{replica_id}.jsonl``
+(``obs/flight.py``) stamped with its OWN monotonic clock.  This tool
+aligns the dumps on shared protocol anchors — events carrying the same
+``(quorum_id, step)`` key, i.e. ``QUORUM_ADOPT`` on replicas and
+``QUORUM_ISSUE`` on the lighthouse, which the whole fleet records within
+one broadcast of each other — and emits a single Chrome trace-event JSON
+loadable in Perfetto / chrome://tracing: one process row per replica,
+every flight event as an instant marker, plus any Chrome-trace span files
+(``obs/spans.py`` exports) merged onto the same timebase.
+
+This is the postmortem view: after an incident, collect the survivors'
+dumps and run::
+
+    python scripts/flight_merge.py --out fleet.trace.json /tmp/flight/flight_*.jsonl
+
+The importable API (:func:`merge_flight_dumps`) additionally returns the
+aligned, time-sorted event list — what the chaos postmortem drill asserts
+its causal chain (injection → lane stalls → poison → reconfig → heal)
+against.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# event ids that anchor cross-replica clock alignment (obs/flight.py:
+# QUORUM_ADOPT=2 on replicas, QUORUM_ISSUE=19 on the lighthouse)
+_ANCHOR_EVS = (2, 19)
+
+
+def read_dump(path: str) -> Tuple[str, List[Dict[str, Any]]]:
+    """(replica_id, events) from one flight_{replica_id}.jsonl dump."""
+    replica_id = os.path.basename(path)
+    events: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("flight_meta"):
+                replica_id = rec.get("replica_id") or replica_id
+                continue
+            replica_id = rec.get("replica_id") or replica_id
+            events.append(rec)
+    return replica_id, events
+
+
+def _anchor_map(
+    events: List[Dict[str, Any]],
+) -> Dict[Tuple[int, int, int], float]:
+    """First observation time of each (event_type, quorum_id, step) anchor.
+    The event type rides the key so only SAME-type events pair across
+    replicas (adopt↔adopt): a replica's QUORUM_ADOPT lands one broadcast
+    after the lighthouse's QUORUM_ISSUE, and pairing the two would bake
+    that RPC latency into the offset."""
+    anchors: Dict[Tuple[int, int, int], float] = {}
+    for ev in events:
+        if ev.get("ev") in _ANCHOR_EVS:
+            key = (
+                int(ev["ev"]),
+                int(ev.get("quorum_id", -1)),
+                int(ev.get("step", -1)),
+            )
+            if key not in anchors and key[1:] != (-1, -1):
+                anchors[key] = float(ev["t"])
+    return anchors
+
+
+def compute_offsets(
+    dumps: Dict[str, List[Dict[str, Any]]],
+    reference: Optional[str] = None,
+) -> Tuple[Dict[str, float], int]:
+    """Per-replica clock offsets (seconds to ADD to a replica's stamps to
+    land on the reference clock) from shared (quorum_id, step) anchors.
+    The reference is the replica with the most anchors unless named.
+    Replicas sharing no anchor with the reference keep offset 0 (same-host
+    fleets already share CLOCK_MONOTONIC).  Returns (offsets, shared-anchor
+    count)."""
+    anchor_maps = {rid: _anchor_map(events) for rid, events in dumps.items()}
+    if reference is None and anchor_maps:
+        # pick the replica whose anchors actually PAIR with the most other
+        # replicas (ties: most anchors) — raw anchor count would elect the
+        # lighthouse, whose QUORUM_ISSUE anchors share a type with nobody
+
+        def _share_score(rid: str):
+            mine = anchor_maps[rid]
+            partners = sum(
+                1
+                for other, theirs in anchor_maps.items()
+                if other != rid and any(k in mine for k in theirs)
+            )
+            return (partners, len(mine))
+
+        reference = max(anchor_maps, key=_share_score)
+    offsets: Dict[str, float] = {}
+    shared_total = 0
+    ref_anchors = anchor_maps.get(reference, {}) if reference else {}
+    for rid, anchors in anchor_maps.items():
+        if rid == reference:
+            # the reference trivially "shares" every one of its own
+            # anchors — counting them would report alignment where none
+            # exists (and make downstream anchors>0 gates vacuous)
+            offsets[rid] = 0.0
+            continue
+        shared = [k for k in anchors if k in ref_anchors]
+        shared_total += len(shared)
+        if not shared:
+            offsets[rid] = 0.0
+            continue
+        offsets[rid] = statistics.median(
+            ref_anchors[k] - anchors[k] for k in shared
+        )
+    return offsets, shared_total
+
+
+def merge_flight_dumps(
+    flight_paths: Sequence[str],
+    span_paths: Sequence[str] = (),
+    reference: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Merge dumps into one aligned fleet timeline.
+
+    Returns ``{"traceEvents": [...], "events": [...], "replicas": [...],
+    "offsets": {...}, "anchors": N}`` — ``traceEvents`` is the
+    Perfetto-loadable Chrome trace, ``events`` the aligned flight events
+    sorted by fleet time (each with ``t_aligned`` and ``replica_id``)."""
+    dumps: Dict[str, List[Dict[str, Any]]] = {}
+    for path in flight_paths:
+        rid, events = read_dump(path)
+        dumps.setdefault(rid, []).extend(events)
+    offsets, anchors = compute_offsets(dumps, reference=reference)
+
+    aligned: List[Dict[str, Any]] = []
+    trace_events: List[Dict[str, Any]] = []
+    replicas = sorted(dumps)
+    pid_of = {rid: i + 1 for i, rid in enumerate(replicas)}
+    for rid in replicas:
+        trace_events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid_of[rid],
+                "args": {"name": rid},
+            }
+        )
+        for ev in dumps[rid]:
+            t_aligned = float(ev["t"]) + offsets[rid]
+            rec = dict(ev)
+            rec["replica_id"] = rid
+            rec["t_aligned"] = round(t_aligned, 6)
+            aligned.append(rec)
+            trace_events.append(
+                {
+                    "name": ev.get("name", f"EV_{ev.get('ev')}"),
+                    "ph": "i",
+                    "s": "p",  # process-scoped instant marker
+                    "ts": round(t_aligned * 1e6, 1),
+                    "pid": pid_of[rid],
+                    "tid": 0,
+                    "args": {
+                        k: v
+                        for k, v in ev.items()
+                        if k not in ("t", "name")
+                    },
+                }
+            )
+    aligned.sort(key=lambda e: e["t_aligned"])
+
+    for path in span_paths:
+        with open(path) as f:
+            doc = json.load(f)
+        for ev in doc.get("traceEvents", []):
+            # span files are per-replica Chrome traces; re-home their pids
+            # past the flight rows so processes never collide
+            if "pid" in ev:
+                ev = dict(ev)
+                ev["pid"] = ev["pid"] + 1000 * (len(replicas) + 1)
+            trace_events.append(ev)
+
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "events": aligned,
+        "replicas": replicas,
+        "offsets": offsets,
+        "anchors": anchors,
+    }
+
+
+def find_chain(
+    events: List[Dict[str, Any]], names: Sequence[str]
+) -> Optional[List[Dict[str, Any]]]:
+    """First strictly-ordered occurrence chain of ``names`` (by event name)
+    in the aligned timeline, or None when the chain is broken — the drill's
+    causal-chain assertion primitive."""
+    chain: List[Dict[str, Any]] = []
+    idx = 0
+    for ev in events:
+        if idx >= len(names):
+            break
+        if ev.get("name") == names[idx]:
+            chain.append(ev)
+            idx += 1
+    return chain if len(chain) == len(names) else None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="merge flight dumps into one Perfetto fleet timeline"
+    )
+    parser.add_argument("dumps", nargs="+", help="flight_*.jsonl dump files")
+    parser.add_argument(
+        "--spans",
+        action="append",
+        default=[],
+        help="Chrome-trace span file(s) to merge (repeatable)",
+    )
+    parser.add_argument(
+        "--out", default="fleet.trace.json", help="output trace path"
+    )
+    parser.add_argument(
+        "--reference", default=None, help="replica id to align clocks against"
+    )
+    args = parser.parse_args(argv)
+    merged = merge_flight_dumps(
+        args.dumps, span_paths=args.spans, reference=args.reference
+    )
+    with open(args.out, "w") as f:
+        json.dump(
+            {
+                "traceEvents": merged["traceEvents"],
+                "displayTimeUnit": merged["displayTimeUnit"],
+            },
+            f,
+        )
+    print(
+        f"merged {len(merged['events'])} events from "
+        f"{len(merged['replicas'])} replicas "
+        f"({merged['anchors']} shared anchors) -> {args.out}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

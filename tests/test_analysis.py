@@ -1238,6 +1238,136 @@ class TestInfrastructure:
         }
 
 
+class TestNativeMirrorFlightEvents:
+    GOOD = (
+        "constexpr uint32_t kFlightCommConfigure = 20;\n"
+        "constexpr uint32_t kFlightCommAbort = 21;\n"
+        "size_t flight_drain(uint64_t* s, double* t, uint32_t* e,\n"
+        "                    int64_t* a, int64_t* b, size_t cap) {}\n"
+        "void x() { flight_record(kFlightCommConfigure, rank, world_size); }\n"
+        "void y() { flight_record(kFlightCommAbort, 0, 0); }\n"
+    )
+
+    def test_good_twin_quiet(self):
+        findings = nativemirror.check_flight_events(self.GOOD, "native/comm.h")
+        assert findings == [], [f.render() for f in findings]
+
+    def test_drifted_event_id_flagged(self):
+        bad = self.GOOD.replace(
+            "kFlightCommAbort = 21", "kFlightCommAbort = 99"
+        )
+        findings = nativemirror.check_flight_events(bad, "native/comm.h")
+        assert any(
+            f.symbol == "kFlightCommAbort" and "99" in f.message
+            for f in findings
+        )
+
+    def test_unknown_native_event_flagged(self):
+        bad = self.GOOD + "constexpr uint32_t kFlightMadeUp = 77;\n"
+        findings = nativemirror.check_flight_events(bad, "native/comm.h")
+        assert any(
+            f.symbol == "kFlightMadeUp" and "no Python counterpart" in f.message
+            for f in findings
+        )
+
+    def test_missing_ring_flagged(self):
+        findings = nativemirror.check_flight_events("// empty\n", "native/comm.h")
+        symbols = {f.symbol for f in findings}
+        assert "kFlightEvents" in symbols
+        assert "flight_drain" in symbols
+        assert "flight_record.configure" in symbols
+
+    def test_ring_slot_value_drift_flagged(self):
+        comm = "constexpr size_t kFlightRingSlots = 512;\n"
+        binding = (
+            "def flight_drain(self):\n"
+            "    cap = 256  # mirror of comm.h kFlightRingSlots\n"
+        )
+        findings = nativemirror.check_flight_ring_slots(comm, binding)
+        assert any(
+            f.symbol == "flight_drain.cap" and "512" in f.message
+            for f in findings
+        )
+        good = binding.replace("256", "512")
+        assert nativemirror.check_flight_ring_slots(comm, good) == []
+
+
+class TestMetricsRegistry:
+    GOOD_REGISTRY = '''
+_m("torchft_lh_quorum_id", "gauge", "Current quorum id")
+_m("torchft_mgr_comm_stalls_total", "counter", "Cumulative stalls")
+'''
+
+    def test_good_declarations_quiet(self):
+        from torchft_tpu.analysis import metricscheck
+
+        findings = metricscheck.check_declarations(
+            self.GOOD_REGISTRY, "torchft_tpu/obs/metrics.py"
+        )
+        assert findings == [], [f.render() for f in findings]
+
+    def test_duplicate_declaration_flagged(self):
+        from torchft_tpu.analysis import metricscheck
+
+        bad = self.GOOD_REGISTRY + '_m("torchft_lh_quorum_id", "gauge", "dup")\n'
+        findings = metricscheck.check_declarations(bad, "metrics.py")
+        assert any(
+            f.symbol == "torchft_lh_quorum_id" and "twice" in f.message
+            for f in findings
+        )
+
+    def test_counter_without_total_flagged(self):
+        from torchft_tpu.analysis import metricscheck
+
+        bad = '_m("torchft_mgr_stalls", "counter", "missing suffix")\n'
+        findings = metricscheck.check_declarations(bad, "metrics.py")
+        assert any("_total" in f.message for f in findings)
+
+    def test_illegal_name_flagged(self):
+        from torchft_tpu.analysis import metricscheck
+
+        # the extraction regex requires the torchft prefix shape, so seed
+        # an uppercase-bearing name through the declaration parser directly
+        decls = metricscheck.parse_declarations(
+            '_m("torchft_lh_BadName", "gauge", "x")\n'
+        )
+        assert decls  # parsed…
+        findings = metricscheck.check_declarations(
+            '_m("torchft_lh_BadName", "gauge", "x")\n', "metrics.py"
+        )
+        assert any("not a legal" in f.message for f in findings)
+
+    def test_undeclared_serving_site_flagged(self):
+        from torchft_tpu.analysis import metricscheck
+
+        source = 'sample = metric_sample("torchft_mgr_not_declared_total", 1)\n'
+        findings = metricscheck.check_serving_sites(
+            source, "torchft_tpu/x.py", {"torchft_mgr_comm_stalls_total": "counter"}
+        )
+        assert any(
+            f.symbol == "torchft_mgr_not_declared_total" for f in findings
+        )
+
+    def test_declared_serving_site_quiet(self):
+        from torchft_tpu.analysis import metricscheck
+
+        source = 'metric_sample("torchft_mgr_comm_stalls_total", 1)\n'
+        findings = metricscheck.check_serving_sites(
+            source, "torchft_tpu/x.py", {"torchft_mgr_comm_stalls_total": "counter"}
+        )
+        assert findings == []
+
+    def test_docs_drift_both_directions(self):
+        from torchft_tpu.analysis import metricscheck
+
+        declared = {"torchft_lh_quorum_id": "gauge"}
+        doc = "the doc mentions `torchft_lh_stale_metric` only\n"
+        findings = metricscheck.check_docs(doc, declared, "docs/operations.md")
+        symbols = {f.symbol for f in findings}
+        assert "torchft_lh_stale_metric" in symbols  # doc'd but undeclared
+        assert "torchft_lh_quorum_id" in symbols  # declared but undoc'd
+
+
 class TestCleanTree:
     def test_full_suite_clean_on_repo(self):
         result = core.run_checkers(root=REPO)

@@ -1,0 +1,339 @@
+"""Flight recorder, trace spans, and the fleet merge tool.
+
+Covers the ISSUE-14 tentpole units: ring semantics (cap, rotation, sticky
+context), dump triggers (comm-epoch poison, the Manager error funnel,
+SIGUSR2, explicit shutdown), atomic dump files, the native C-ring drain
+(gated on the native build), Chrome-trace span export, and
+``scripts/flight_merge.py`` clock alignment + causal-chain search.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+from torchft_tpu.obs import flight as flight_mod
+from torchft_tpu.obs import spans as spans_mod
+from torchft_tpu.obs.flight import FlightEvent, FlightRecorder
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+)
+import flight_merge  # noqa: E402
+
+
+class TestRing:
+    def test_cap_and_rotation(self):
+        rec = FlightRecorder("r0", cap=4)
+        for i in range(10):
+            rec.record(FlightEvent.QUORUM_START, step=i)
+        events = rec.snapshot()
+        assert len(events) == 4
+        assert [e["step"] for e in events] == [6, 7, 8, 9]
+        assert events[0]["seq"] == 6  # seq keeps counting past rotation
+
+    def test_disabled_records_nothing(self):
+        rec = FlightRecorder("r0", cap=0)
+        rec.record(FlightEvent.ERROR, error="x")
+        assert len(rec) == 0
+        assert rec.snapshot() == []
+        assert rec.dump("test") is None
+
+    def test_sticky_context(self):
+        rec = FlightRecorder("r0", cap=16)
+        rec.set_context(step=5, quorum_id=2)
+        rec.set_comm_epoch(3)
+        rec.record(FlightEvent.COMMIT_VOTE)
+        rec.record(FlightEvent.COMM_POISON, step=9)  # explicit overrides
+        events = rec.snapshot()
+        assert events[0]["step"] == 5
+        assert events[0]["quorum_id"] == 2
+        assert events[0]["comm_epoch"] == 3
+        assert events[1]["step"] == 9
+        assert events[1]["quorum_id"] == 2
+
+    def test_detail_kwargs_ride_the_event(self):
+        rec = FlightRecorder("r0", cap=16)
+        rec.record(FlightEvent.LANE_RECONNECT, peer=2, lane=1)
+        event = rec.snapshot()[0]
+        assert event["name"] == "LANE_RECONNECT"
+        assert event["peer"] == 2 and event["lane"] == 1
+
+    def test_concurrent_records_never_lose_the_ring(self):
+        rec = FlightRecorder("r0", cap=1024)
+
+        def spam():
+            for i in range(500):
+                rec.record(FlightEvent.QUORUM_START, step=i)
+
+        threads = [threading.Thread(target=spam) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        events = rec.snapshot()
+        assert len(events) == 1024
+        # monotonic non-decreasing stamps (appends are ordered per deque)
+        stamps = [e["t"] for e in events]
+        assert all(b >= a - 1e-3 for a, b in zip(stamps, stamps[1:]))
+
+
+class TestDump:
+    def test_dump_writes_jsonl_atomically(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHFT_FLIGHT_DIR", str(tmp_path))
+        rec = FlightRecorder("rep/0", cap=16)
+        rec.record(FlightEvent.QUORUM_ADOPT, step=1, quorum_id=1, world=3)
+        path = rec.dump("test")
+        assert path is not None and os.path.exists(path)
+        assert os.path.basename(path) == "flight_rep_0.jsonl"  # sanitized
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["flight_meta"] == 1
+        assert lines[0]["reason"] == "test"
+        assert lines[1]["name"] == "QUORUM_ADOPT"
+        assert lines[1]["replica_id"] == "rep/0"
+        # a second dump REWRITES (newest complete ring, no duplicates)
+        rec.record(FlightEvent.COMMIT_RESULT, step=1, committed=True)
+        rec.dump("again")
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["reason"] == "again"
+        assert len(lines) == 3  # meta + 2 events
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+    def test_maybe_dump_rate_limited(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHFT_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("TORCHFT_FLIGHT_DUMP_MIN_S", "100")
+        rec = FlightRecorder("r0", cap=16)
+        rec.record(FlightEvent.ERROR, error="boom")
+        assert rec.maybe_dump("poison") is not None
+        assert rec.maybe_dump("poison") is None  # inside the window
+        assert rec.dumps_total == 1
+
+    def test_comm_poison_triggers_dump(self, tmp_path, monkeypatch):
+        from torchft_tpu.communicator import TCPCommunicator
+
+        monkeypatch.setenv("TORCHFT_FLIGHT_DIR", str(tmp_path))
+        comm = TCPCommunicator(timeout_s=2.0)
+        comm.flight = FlightRecorder("poisoned", cap=64)
+        comm.abort("injected failure")
+        names = [e["name"] for e in comm.flight.snapshot()]
+        assert "COMM_ABORT" in names
+        assert "COMM_POISON" in names
+        assert os.path.exists(tmp_path / "flight_poisoned.jsonl")
+        # shutdown is NOT a poison (no second dump, no poison event)
+        comm2 = TCPCommunicator(timeout_s=2.0)
+        comm2.flight = FlightRecorder("cleanshut", cap=64)
+        comm2.shutdown()
+        names2 = [e["name"] for e in comm2.flight.snapshot()]
+        assert "COMM_POISON" not in names2
+
+    def test_error_funnel_triggers_dump(self, tmp_path, monkeypatch):
+        from unittest.mock import MagicMock
+
+        from torchft_tpu.communicator import DummyCommunicator
+        from torchft_tpu.manager import Manager
+
+        monkeypatch.setenv("TORCHFT_FLIGHT_DIR", str(tmp_path))
+        manager = Manager(
+            comm=DummyCommunicator(),
+            min_replica_size=1,
+            replica_id="funnel_test",
+            _manager_client=MagicMock(),
+        )
+        manager.report_error(RuntimeError("funnel me"))
+        events = manager._flight.snapshot()
+        assert any(
+            e["name"] == "ERROR" and "funnel me" in e.get("error", "")
+            for e in events
+        )
+        assert os.path.exists(tmp_path / "flight_funnel_test.jsonl")
+
+    def test_sigusr2_dumps_every_live_recorder(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHFT_FLIGHT_DIR", str(tmp_path))
+        a = FlightRecorder("sig_a", cap=16)
+        b = FlightRecorder("sig_b", cap=16)
+        a.record(FlightEvent.QUORUM_START, step=1)
+        b.record(FlightEvent.QUORUM_START, step=2)
+        # invoke the handler body directly (raising the real signal would
+        # race other tests' recorders into the dump set); it hands the
+        # dump to a daemon thread — a signal handler must never take the
+        # native drain locks inline — so poll for the files
+        flight_mod._on_sigusr2(signal.SIGUSR2, None)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not (
+            os.path.exists(tmp_path / "flight_sig_a.jsonl")
+            and os.path.exists(tmp_path / "flight_sig_b.jsonl")
+        ):
+            time.sleep(0.02)
+        assert os.path.exists(tmp_path / "flight_sig_a.jsonl")
+        assert os.path.exists(tmp_path / "flight_sig_b.jsonl")
+
+
+@pytest.mark.skipif(
+    not __import__("torchft_tpu.native", fromlist=["available"]).available(),
+    reason="native runtime unavailable",
+)
+class TestNativeRing:
+    def test_configure_abort_recorded_and_drained_once(self):
+        from torchft_tpu.native import CppCommunicator
+        from torchft_tpu.store import StoreServer
+
+        store = StoreServer("127.0.0.1:0")
+        comm = CppCommunicator(timeout_s=5.0)
+        comm.flight = FlightRecorder("native_t", cap=64)
+        try:
+            comm.configure(f"127.0.0.1:{store.port}/t/0", "r0", 0, 1)
+            drained = comm.flight_drain()
+            assert [e["ev"] for e in drained] == [
+                int(FlightEvent.COMM_CONFIGURE)
+            ]
+            assert drained[0]["a"] == 0 and drained[0]["b"] == 1
+            assert drained[0]["native"] is True
+            assert comm.flight_drain() == []  # consume semantics
+            comm.abort("drill")
+            # the poison-triggered dump already consumed the C ring into
+            # the Python recorder; the native abort event lives there now
+            native_evs = [
+                e["ev"] for e in comm.flight.snapshot() if e.get("native")
+            ] + [e["ev"] for e in comm.flight_drain()]
+            assert int(FlightEvent.COMM_ABORT) in native_evs
+        finally:
+            comm.shutdown()
+            store.shutdown()
+
+    def test_native_events_merge_into_dump(self, tmp_path, monkeypatch):
+        from torchft_tpu.native import CppCommunicator
+        from torchft_tpu.store import StoreServer
+
+        monkeypatch.setenv("TORCHFT_FLIGHT_DIR", str(tmp_path))
+        store = StoreServer("127.0.0.1:0")
+        comm = CppCommunicator(timeout_s=5.0)
+        comm.flight = FlightRecorder("native_m", cap=64)
+        try:
+            comm.configure(f"127.0.0.1:{store.port}/m/0", "r0", 0, 1)
+            path = comm.flight.dump("test")
+            events = [json.loads(l) for l in open(path)][1:]
+            native = [e for e in events if e.get("native")]
+            assert any(
+                e["ev"] == int(FlightEvent.COMM_CONFIGURE) for e in native
+            )
+        finally:
+            comm.shutdown()
+            store.shutdown()
+
+
+class TestSpans:
+    def setup_method(self):
+        spans_mod.configure(True)
+        spans_mod.clear()
+
+    def teardown_method(self):
+        spans_mod.configure(None)
+        spans_mod.clear()
+
+    def test_nested_spans_record(self):
+        with spans_mod.span("outer", step=1):
+            with spans_mod.span("inner"):
+                pass
+        recs = spans_mod.snapshot()
+        names = [r["name"] for r in recs]
+        assert names == ["inner", "outer"]  # completion order
+        outer = recs[1]
+        assert outer["attrs"] == {"step": 1}
+        assert outer["dur"] >= recs[0]["dur"]
+
+    def test_disabled_is_shared_noop(self):
+        spans_mod.configure(False)
+        s1 = spans_mod.span("a")
+        s2 = spans_mod.span("b")
+        assert s1 is s2  # the shared null context
+        with s1:
+            pass
+        assert spans_mod.snapshot() == []
+
+    def test_chrome_trace_export(self, tmp_path):
+        with spans_mod.span("step", step=3):
+            pass
+        path = tmp_path / "spans.trace.json"
+        n = spans_mod.export_chrome_trace(str(path), replica_id="r0")
+        assert n == 1
+        doc = json.loads(path.read_text())
+        events = doc["traceEvents"]
+        meta = [e for e in events if e["ph"] == "M"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert meta and meta[0]["args"]["name"] == "r0"
+        assert len(xs) == 1
+        assert xs[0]["name"] == "step"
+        assert xs[0]["ts"] > 0 and xs[0]["dur"] >= 0
+        assert xs[0]["args"] == {"step": 3}
+
+
+class TestFlightMerge:
+    def _write_dump(self, path, replica_id, events):
+        with open(path, "w") as f:
+            f.write(
+                json.dumps(
+                    {"flight_meta": 1, "replica_id": replica_id, "events": len(events)}
+                )
+                + "\n"
+            )
+            for e in events:
+                e = dict(e)
+                e["replica_id"] = replica_id
+                f.write(json.dumps(e) + "\n")
+
+    def test_alignment_on_shared_anchors(self, tmp_path):
+        # replica B's clock runs 100 s ahead; both adopted (q=1, step=5)
+        a_events = [
+            {"seq": 0, "t": 10.0, "ev": 2, "name": "QUORUM_ADOPT", "step": 5, "quorum_id": 1, "comm_epoch": 1},
+            {"seq": 1, "t": 11.0, "ev": 22, "name": "COMM_POISON", "step": 5, "quorum_id": 1, "comm_epoch": 1},
+        ]
+        b_events = [
+            {"seq": 0, "t": 110.5, "ev": 2, "name": "QUORUM_ADOPT", "step": 5, "quorum_id": 1, "comm_epoch": 1},
+            {"seq": 1, "t": 112.0, "ev": 10, "name": "HEAL_RECV_END", "step": 5, "quorum_id": 1, "comm_epoch": 1},
+        ]
+        pa, pb = tmp_path / "flight_a.jsonl", tmp_path / "flight_b.jsonl"
+        self._write_dump(pa, "rep_a", a_events)
+        self._write_dump(pb, "rep_b", b_events)
+        merged = flight_merge.merge_flight_dumps([str(pa), str(pb)])
+        assert merged["replicas"] == ["rep_a", "rep_b"]
+        assert merged["anchors"] >= 1
+        # B's offset pulls its anchor onto A's (10.0 vs 110.5 → -100.5)
+        offsets = merged["offsets"]
+        ref = [r for r, off in offsets.items() if off == 0.0]
+        assert ref
+        aligned = {(e["replica_id"], e["name"]): e["t_aligned"] for e in merged["events"]}
+        assert abs(
+            aligned[("rep_a", "QUORUM_ADOPT")] - aligned[("rep_b", "QUORUM_ADOPT")]
+        ) < 1e-6
+        # ordering on the merged timeline holds across the clock skew
+        names = [e["name"] for e in merged["events"]]
+        assert names.index("COMM_POISON") < names.index("HEAL_RECV_END")
+
+    def test_trace_events_loadable(self, tmp_path):
+        events = [
+            {"seq": 0, "t": 1.0, "ev": 2, "name": "QUORUM_ADOPT", "step": 1, "quorum_id": 1, "comm_epoch": 0},
+        ]
+        p = tmp_path / "flight_x.jsonl"
+        self._write_dump(p, "x", events)
+        merged = flight_merge.merge_flight_dumps([str(p)])
+        instants = [e for e in merged["traceEvents"] if e["ph"] == "i"]
+        assert instants and instants[0]["name"] == "QUORUM_ADOPT"
+        # json-serializable end to end (the CLI writes exactly this)
+        json.dumps({"traceEvents": merged["traceEvents"]})
+
+    def test_find_chain(self):
+        events = [
+            {"name": "CHAOS_INJECT", "t_aligned": 1.0},
+            {"name": "NOISE", "t_aligned": 1.5},
+            {"name": "COMM_POISON", "t_aligned": 2.0},
+            {"name": "QUORUM_ADOPT", "t_aligned": 3.0},
+        ]
+        chain = flight_merge.find_chain(
+            events, ["CHAOS_INJECT", "COMM_POISON", "QUORUM_ADOPT"]
+        )
+        assert chain is not None and len(chain) == 3
+        assert flight_merge.find_chain(events, ["COMM_POISON", "CHAOS_INJECT"]) is None

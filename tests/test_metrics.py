@@ -1,0 +1,257 @@
+"""The /metrics plane: registry contract, strict text-format parsing, live
+endpoint scrapes (lighthouse + ManagerServer), and the scrape-storm
+state-lock regression gate (ISSUE 14 acceptance: <= 1 lock acquire per
+TTL under a storm)."""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from torchft_tpu.obs import metrics as m
+
+
+class TestRegistry:
+    def test_names_legal_and_counters_total(self):
+        for metric in m.REGISTRY.values():
+            assert m._NAME_RE.match(metric.name), metric.name
+            assert metric.kind in ("gauge", "counter")
+            if metric.kind == "counter":
+                assert metric.name.endswith("_total"), metric.name
+            assert metric.doc
+
+    def test_undeclared_sample_raises(self):
+        with pytest.raises(KeyError):
+            m.metric_sample("torchft_lh_not_a_metric", 1)
+
+    def test_none_value_drops_sample(self):
+        assert m.metric_sample("torchft_lh_quorum_id", None) is None
+
+    def test_duplicate_declaration_raises(self):
+        with pytest.raises(ValueError):
+            m._m("torchft_lh_quorum_id", "gauge", "dup")
+
+    def test_illegal_counter_name_raises(self):
+        with pytest.raises(ValueError):
+            m._m("torchft_lh_bad_counter", "counter", "no _total suffix")
+
+
+class TestRenderAndParse:
+    def test_roundtrip_with_labels_and_escapes(self):
+        text = m.render(
+            [
+                m.metric_sample("torchft_lh_quorum_id", 3),
+                m.metric_sample(
+                    "torchft_lh_heartbeat_age_seconds",
+                    1.25,
+                    {"replica_id": 'weird"id\\with\nstuff'},
+                ),
+                m.metric_sample("torchft_lh_promotions_total", 2),
+                None,  # dropped optional gauge
+            ]
+        )
+        parsed = m.parse_prometheus_text(text)
+        assert parsed["torchft_lh_quorum_id"] == [({}, 3.0)]
+        labels, value = parsed["torchft_lh_heartbeat_age_seconds"][0]
+        assert labels == {"replica_id": 'weird"id\\with\nstuff'}
+        assert value == 1.25
+        assert parsed["torchft_lh_promotions_total"] == [({}, 2.0)]
+
+    def test_strict_parser_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            m.parse_prometheus_text("not a metric line\n")
+        with pytest.raises(ValueError):
+            # sample without HELP/TYPE headers
+            m.parse_prometheus_text("torchft_lh_quorum_id 1\n")
+        with pytest.raises(ValueError):
+            m.parse_prometheus_text(
+                "# HELP torchft_lh_quorum_id x\n"
+                "# TYPE torchft_lh_quorum_id notakind\n"
+                "torchft_lh_quorum_id 1\n"
+            )
+
+
+@pytest.fixture
+def lighthouse():
+    from torchft_tpu.lighthouse import LighthouseServer
+
+    server = LighthouseServer(bind="127.0.0.1:0", min_replicas=1)
+    yield server
+    server.shutdown()
+
+
+def _scrape(port: int) -> str:
+    return (
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10)
+        .read()
+        .decode()
+    )
+
+
+class TestLighthouseEndpoint:
+    def test_scrape_parses_strictly(self, lighthouse):
+        parsed = m.parse_prometheus_text(_scrape(lighthouse.port))
+        assert parsed["torchft_lh_quorum_id"] == [({}, 0.0)]
+        assert "torchft_lh_status_rebuilds_total" in parsed
+        for name in parsed:
+            assert name in m.REGISTRY, f"{name} served but not declared"
+
+    def test_scrape_reflects_fleet_state(self, lighthouse):
+        from torchft_tpu.manager_server import ManagerServer
+
+        ms = ManagerServer(
+            "metrics_rep",
+            lighthouse.local_address(),
+            hostname="127.0.0.1",
+            bind="127.0.0.1:0",
+        )
+        try:
+            deadline = time.monotonic() + 10.0
+            parsed = {}
+            while time.monotonic() < deadline:
+                parsed = m.parse_prometheus_text(_scrape(lighthouse.port))
+                ages = parsed.get("torchft_lh_heartbeat_age_seconds", [])
+                if any(l.get("replica_id") == "metrics_rep" for l, _ in ages):
+                    break
+                time.sleep(0.2)
+            ages = parsed["torchft_lh_heartbeat_age_seconds"]
+            assert any(
+                l.get("replica_id") == "metrics_rep" for l, _ in ages
+            ), parsed
+        finally:
+            ms.shutdown()
+
+    def test_metrics_disabled_404(self, lighthouse, monkeypatch):
+        monkeypatch.setenv("TORCHFT_METRICS", "0")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _scrape(lighthouse.port)
+        assert err.value.code == 404
+
+    def test_scrape_storm_lock_regression(self, lighthouse, monkeypatch):
+        """The acceptance gate: a /metrics scrape storm acquires the quorum
+        state lock at most once per TTL (plus one warm-up rebuild)."""
+        monkeypatch.setenv("TORCHFT_STATUS_TTL_S", "0.5")
+        _scrape(lighthouse.port)  # prime the cache
+        before = lighthouse.status_lock_acquires
+        stop = threading.Event()
+        errors = []
+
+        def storm():
+            while not stop.is_set():
+                try:
+                    _scrape(lighthouse.port)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=storm) for _ in range(8)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        elapsed = time.monotonic() - t0
+        assert not errors, errors
+        rebuilds = lighthouse.status_lock_acquires - before
+        # <= 1 rebuild per TTL window elapsed, + 1 for the boundary
+        assert rebuilds <= int(elapsed / 0.5) + 1, (
+            f"{rebuilds} state-lock acquires in {elapsed:.2f}s of storm "
+            f"(TTL 0.5s) — the scrape cache regressed"
+        )
+
+
+class TestManagerServerEndpoint:
+    def test_scrape_parses_and_merges_providers(self, lighthouse):
+        from torchft_tpu.manager_server import ManagerServer
+        from torchft_tpu.wire import CommHealth
+
+        ms = ManagerServer(
+            "mgr_metrics",
+            lighthouse.local_address(),
+            hostname="127.0.0.1",
+            bind="127.0.0.1:0",
+            health_fn=lambda: CommHealth(
+                stalls=3, reconnects=1, failovers=0, faults=2,
+                tx_bytes=100, rx_bytes=200,
+            ),
+            metrics_fn=lambda: {
+                "torchft_mgr_step": 41.0,
+                "torchft_mgr_quorum_id": 5.0,
+                "torchft_mgr_capacity": 0.75,
+            },
+        )
+        try:
+            parsed = m.parse_prometheus_text(_scrape(ms.port))
+            assert parsed["torchft_mgr_step"] == [({}, 41.0)]
+            assert parsed["torchft_mgr_quorum_id"] == [({}, 5.0)]
+            assert parsed["torchft_mgr_capacity"] == [({}, 0.75)]
+            assert parsed["torchft_mgr_comm_stalls_total"] == [({}, 3.0)]
+            assert parsed["torchft_mgr_comm_faults_total"] == [({}, 2.0)]
+            assert "torchft_mgr_beats_direct_total" in parsed
+            for name in parsed:
+                assert name in m.REGISTRY, f"{name} served but not declared"
+        finally:
+            ms.shutdown()
+
+    def test_rpc_clients_unaffected_by_http_sniff(self, lighthouse):
+        # the HTTP sniff must not break the framed-RPC path on the port
+        from torchft_tpu.manager_server import ManagerClient, ManagerServer
+
+        ms = ManagerServer(
+            "sniff_rep",
+            lighthouse.local_address(),
+            hostname="127.0.0.1",
+            bind="127.0.0.1:0",
+            world_size=1,
+        )
+        client = ManagerClient(
+            f"127.0.0.1:{ms.port}", connect_timeout=5.0
+        )
+        try:
+            _scrape(ms.port)  # interleave an HTTP request
+            result = client._quorum(
+                group_rank=0,
+                step=0,
+                checkpoint_metadata="",
+                shrink_only=False,
+                timeout=10.0,
+            )
+            assert result.quorum_id >= 1
+        finally:
+            client.close()
+            ms.shutdown()
+
+    def test_ttl_cache_bounds_provider_polls(self, lighthouse, monkeypatch):
+        from torchft_tpu.manager_server import ManagerServer
+
+        monkeypatch.setenv("TORCHFT_METRICS_TTL_S", "10")
+        calls = []
+        ms = ManagerServer(
+            "ttl_rep",
+            lighthouse.local_address(),
+            hostname="127.0.0.1",
+            bind="127.0.0.1:0",
+            metrics_fn=lambda: calls.append(1) or {"torchft_mgr_step": 1.0},
+        )
+        try:
+            for _ in range(5):
+                _scrape(ms.port)
+            assert len(calls) == 1, (
+                f"{len(calls)} provider polls for 5 scrapes inside one TTL"
+            )
+        finally:
+            ms.shutdown()
+
+
+class TestFtlintMetricsChecker:
+    def test_repo_is_clean(self):
+        import os
+
+        from torchft_tpu.analysis import metricscheck
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        findings = metricscheck.check(root)
+        assert findings == [], [f.render() for f in findings]

@@ -1,0 +1,141 @@
+"""Direct units for observability.py: the structured-logging substrate.
+
+Previously covered only indirectly through drills (ISSUE 14 satellite):
+the allowed-keys filtering of the JSONL formatter (an attacker-controlled
+or just-misspelled extra key must never leak into the structured stream)
+and the ``log_heal`` record shape the ``torchft_heals`` consumers parse.
+"""
+
+import json
+import logging
+
+from torchft_tpu import observability as obs
+
+
+class _Capture(logging.Handler):
+    def __init__(self) -> None:
+        super().__init__()
+        self.records = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        self.records.append(record)
+
+
+def _format(record_extra: dict) -> dict:
+    logger = logging.getLogger("torchft_quorums")
+    record = logger.makeRecord(
+        "torchft_quorums", logging.INFO, __file__, 1, "", (), None,
+        extra=record_extra,
+    )
+    return json.loads(obs._JsonLinesFormatter().format(record))
+
+
+class TestAllowedKeysFiltering:
+    def test_allowed_keys_pass_through(self):
+        event = _format(
+            {
+                "job_id": "j1",
+                "replica_id": "r0",
+                "rank": 3,
+                "quorum_id": 7,
+                "step": 41,
+                "comm_lanes": 4,
+                "heal_bytes": 1024,
+            }
+        )
+        assert event["event"] == "torchft_quorums"
+        assert event["replica_id"] == "r0"
+        assert event["rank"] == 3
+        assert event["quorum_id"] == 7
+        assert event["step"] == 41
+        assert event["comm_lanes"] == 4
+        assert event["heal_bytes"] == 1024
+        assert "ts" in event
+
+    def test_unknown_keys_filtered(self):
+        event = _format(
+            {
+                "step": 1,
+                "not_an_allowed_key": "leaks?",
+                "password": "hunter2",
+            }
+        )
+        assert event["step"] == 1
+        assert "not_an_allowed_key" not in event
+        assert "password" not in event
+
+    def test_every_attr_key_is_filterable(self):
+        # the formatter iterates _ATTR_KEYS: every declared key must come
+        # through when set, so the allowlist and the formatter can't drift
+        extra = {k: 1 for k in obs._ATTR_KEYS}
+        event = _format(extra)
+        for key in obs._ATTR_KEYS:
+            assert event[key] == 1, key
+
+    def test_flight_keys_declared(self):
+        # the torchft_flight dump announcements ride the same formatter
+        for key in (
+            "flight_reason",
+            "flight_events",
+            "flight_native_events",
+            "flight_path",
+        ):
+            assert key in obs._ATTR_KEYS
+        assert "torchft_flight" in obs.STRUCTURED_LOGGERS
+
+
+class TestLogHeal:
+    def test_log_heal_record_shape(self):
+        metrics = obs.HealMetrics(
+            step=12,
+            num_sources=3,
+            bytes_total=4096,
+            duration_s=2.0,
+            per_source_bytes={0: 2048, 1: 2048},
+            failed_sources=[2],
+            stolen_chunks=5,
+        )
+        capture = _Capture()
+        logger = logging.getLogger("torchft_heals")
+        logger.addHandler(capture)
+        logger.setLevel(logging.INFO)
+        try:
+            obs.log_heal(metrics, replica_id="r1", rank=2, quorum_id=9)
+        finally:
+            logger.removeHandler(capture)
+        assert len(capture.records) == 1
+        rec = capture.records[0]
+        assert rec.replica_id == "r1"
+        assert rec.rank == 2
+        assert rec.quorum_id == 9
+        assert rec.step == 12
+        assert rec.heal_bytes == 4096
+        assert rec.heal_duration_s == 2.0
+        assert rec.heal_bytes_per_sec == 2048.0
+        assert rec.heal_num_sources == 3
+        assert rec.heal_failed_sources == [2]
+        assert rec.heal_stolen_chunks == 5
+        assert rec.heal_per_source_bytes == {0: 2048, 1: 2048}
+
+    def test_log_heal_formats_to_allowed_json(self):
+        # end to end: the record the logger emits serializes through the
+        # JSONL formatter with every heal key intact
+        metrics = obs.HealMetrics(step=3, bytes_total=10, duration_s=0.5)
+        capture = _Capture()
+        logger = logging.getLogger("torchft_heals")
+        logger.addHandler(capture)
+        logger.setLevel(logging.INFO)
+        try:
+            obs.log_heal(metrics, replica_id="rX")
+        finally:
+            logger.removeHandler(capture)
+        event = json.loads(
+            obs._JsonLinesFormatter().format(capture.records[0])
+        )
+        assert event["event"] == "torchft_heals"
+        assert event["heal_bytes"] == 10
+        assert event["heal_bytes_per_sec"] == 20.0
+        assert event["replica_id"] == "rX"
+
+    def test_bytes_per_sec_zero_duration(self):
+        assert obs.HealMetrics(bytes_total=100, duration_s=0.0).bytes_per_sec == 0.0

@@ -31,6 +31,11 @@ shows reviewers keep having to catch by hand:
   environment knob mentioned in source must be declared in
   ``torchft_tpu/knobs.py``, and the knob table in ``docs/operations.md``
   must agree with the registry in both directions.
+- ``metrics-registry`` (:mod:`.metricscheck`): every name served on a
+  ``/metrics`` endpoint must be declared exactly once in
+  ``torchft_tpu/obs/metrics.py``, Prometheus-legal (counters end in
+  ``_total``), documented in ``docs/operations.md`` §17, and every
+  metric-shaped literal in source must name a declared metric.
 - ``native-mirror`` (:mod:`.nativemirror`): the hand-mirrored constants
   shared with the C++ tier (``native/comm.h`` / ``native/wire.h`` — lane
   hello flag, 64-byte stripe alignment, frame cap, message types, the
@@ -61,6 +66,7 @@ CHECKERS = (
     "executor-starvation",
     "wire-protocol",
     "knob-registry",
+    "metrics-registry",
     "native-mirror",
     "native-locks",
 )

@@ -154,6 +154,7 @@ def run_checkers(
     from torchft_tpu.analysis import (
         concurrency,
         knobcheck,
+        metricscheck,
         nativelocks,
         nativemirror,
         threads,
@@ -168,6 +169,7 @@ def run_checkers(
         "executor-starvation": concurrency.check_starvation,
         "wire-protocol": wireproto.check,
         "knob-registry": knobcheck.check,
+        "metrics-registry": metricscheck.check,
         "native-mirror": nativemirror.check,
         "native-locks": nativelocks.check,
     }

@@ -28,6 +28,11 @@ every shared constant against its live Python counterpart:
   ``lane_rx_bytes`` / ``lane_stalls`` counters and ``native.py`` must
   export the same ``lane_stats()`` keys the Python tier does, so
   ``manager.last_quorum_timings`` stays tier-agnostic
+- flight-recorder event ids: every ``kFlight<Name> = N`` constant in
+  ``comm.h`` must match ``obs.flight.FlightEvent.<NAME>`` (CamelCase →
+  UPPER_SNAKE) value-for-value, the C ring must exist
+  (``tpuft_comm_flight_drain`` + the configure/abort record sites), and
+  the binding must mirror the ring slot count
 - the ``outer_shard_parts`` padding formula matches the canonical
   ceil-to-unit form, and mirrored symbols (``HostTopology`` with its
   ``worth_it`` auto criterion, ``lane_parts``, ``outer_shard_parts``)
@@ -383,6 +388,79 @@ def check_comm_header(text: str, rel: str = _COMM_H) -> List[Finding]:
                     "tier-agnostic lane_stats surface is broken",
                 )
             )
+
+    findings.extend(check_flight_events(text, rel))
+    return findings
+
+
+def _camel_to_upper_snake(name: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).upper()
+
+
+def check_flight_events(text: str, rel: str = _COMM_H) -> List[Finding]:
+    """The C-side flight ring's event-id mirror: every ``kFlight<Name>``
+    constant must match ``obs.flight.FlightEvent.<NAME>`` value-for-value,
+    and the ring itself (drain + record sites) must exist."""
+    from torchft_tpu.obs.flight import FlightEvent
+
+    findings: List[Finding] = []
+    py_events = {e.name: e.value for e in FlightEvent}
+    native_ids = re.findall(
+        r"kFlight([A-Za-z0-9]+)\s*=\s*(\d+)\s*;", text
+    )
+    event_ids = [
+        (cname, value)
+        for cname, value in native_ids
+        if cname not in ("RingSlots",)
+    ]
+    if not event_ids:
+        findings.append(
+            _finding(
+                rel,
+                1,
+                "kFlightEvents",
+                "no kFlight* event ids found in comm.h — the native tier "
+                "no longer mirrors the obs/flight.py event enum",
+            )
+        )
+    for cname, value_str in event_ids:
+        pyname = _camel_to_upper_snake(cname)
+        value = int(value_str)
+        if pyname not in py_events:
+            findings.append(
+                _finding(
+                    rel,
+                    _line_of(text, rf"kFlight{cname}"),
+                    f"kFlight{cname}",
+                    f"native flight event kFlight{cname} has no Python "
+                    f"counterpart (FlightEvent.{pyname} missing)",
+                )
+            )
+        elif py_events[pyname] != value:
+            findings.append(
+                _finding(
+                    rel,
+                    _line_of(text, rf"kFlight{cname}"),
+                    f"kFlight{cname}",
+                    f"native kFlight{cname} = {value} but Python "
+                    f"FlightEvent.{pyname} = {py_events[pyname]}",
+                )
+            )
+    for symbol, pattern in (
+        ("flight_drain", r"\bflight_drain\s*\("),
+        ("flight_record.configure", r"flight_record\(kFlightCommConfigure"),
+        ("flight_record.abort", r"flight_record\(kFlightCommAbort"),
+    ):
+        if not re.search(pattern, text):
+            findings.append(
+                _finding(
+                    rel,
+                    1,
+                    symbol,
+                    f"flight-ring symbol {symbol} not found in comm.h — "
+                    "the native epoch lifecycle is no longer recorded",
+                )
+            )
     return findings
 
 
@@ -413,11 +491,61 @@ def check_binding(text: str, rel: str = _BINDING) -> List[Finding]:
                     "native tier",
                 )
             )
+    # flight-ring binding: the C-side ring must actually drain into dumps
+    if "tpuft_comm_flight_drain" not in text:
+        findings.append(
+            _finding(
+                rel,
+                1,
+                "tpuft_comm_flight_drain",
+                "native.py never calls tpuft_comm_flight_drain — the "
+                "C-side flight ring would never merge into Python dumps",
+            )
+        )
+    if not re.search(r"#\s*mirror of comm\.h kFlightRingSlots", text):
+        findings.append(
+            _finding(
+                rel,
+                _line_of(text, r"def flight_drain"),
+                "flight_drain.cap",
+                "flight_drain's drain capacity is not annotated as the "
+                "kFlightRingSlots mirror — a comm.h resize would silently "
+                "truncate drains",
+            )
+        )
     return findings
+
+
+def check_flight_ring_slots(
+    comm_text: str, binding_text: str, rel: str = _BINDING
+) -> List[Finding]:
+    """Cross-file value check: the binding's drain capacity must EQUAL
+    comm.h's kFlightRingSlots — a comment alone would let a ring resize
+    silently truncate drains."""
+    native = re.search(r"kFlightRingSlots\s*=\s*(\d+)", comm_text)
+    binding = re.search(
+        r"cap\s*=\s*(\d+)\s*#\s*mirror of comm\.h kFlightRingSlots",
+        binding_text,
+    )
+    if not native or not binding:
+        return []  # absence findings come from the per-file checks
+    if int(native.group(1)) != int(binding.group(1)):
+        return [
+            _finding(
+                rel,
+                _line_of(binding_text, r"def flight_drain"),
+                "flight_drain.cap",
+                f"flight_drain drains at most {binding.group(1)} events "
+                f"but comm.h kFlightRingSlots = {native.group(1)} — a "
+                f"full native ring would silently truncate at dump time",
+            )
+        ]
+    return []
 
 
 def check(root: str) -> List[Finding]:
     findings: List[Finding] = []
+    texts: dict = {}
     for rel, fn in (
         (_WIRE_H, check_wire_header),
         (_COMM_H, check_comm_header),
@@ -435,5 +563,14 @@ def check(root: str) -> List[Finding]:
             )
             continue
         with open(path) as f:
-            findings.extend(fn(f.read(), rel.replace(os.sep, "/")))
+            texts[rel] = f.read()
+        findings.extend(fn(texts[rel], rel.replace(os.sep, "/")))
+    if _COMM_H in texts and _BINDING in texts:
+        findings.extend(
+            check_flight_ring_slots(
+                texts[_COMM_H],
+                texts[_BINDING],
+                _BINDING.replace(os.sep, "/"),
+            )
+        )
     return findings

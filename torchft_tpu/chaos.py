@@ -95,6 +95,23 @@ _GRAY_DEFAULT_SPECS = {
 }
 
 
+def _flight_note(obj: Any, failure: Failure, **detail: Any) -> None:
+    """Best-effort CHAOS_INJECT into the victim's flight recorder — the
+    injection anchor a postmortem timeline chains its causal sequence
+    from.  Reaches the recorder through the victim's manager (thread
+    plane) or its communicator attachment; silently a no-op when neither
+    exists (mock harnesses)."""
+    manager = getattr(obj, "manager", None)
+    flight = getattr(manager, "_flight", None)
+    if flight is None:
+        flight = getattr(getattr(obj, "comm", None), "flight", None)
+    if flight is None:
+        return
+    from torchft_tpu.obs.flight import FlightEvent
+
+    flight.record(FlightEvent.CHAOS_INJECT, failure=failure.value, **detail)
+
+
 def arm_heal_source_kill(
     transport: Any,
     after_bytes: int = 1 << 20,
@@ -220,6 +237,20 @@ class ThreadReplica(ReplicaHandle):
         return bool(topo and topo.get("is_leader"))
 
     def inject(self, failure: Failure, **kw: Any) -> None:
+        if failure not in _GRAY_DEFAULT_SPECS:
+            # gray classes record their CHAOS_INJECT inside
+            # comm.arm_faults (which this inject routes through) — noting
+            # them here too would double-record every injection
+            _flight_note(
+                self._obj,
+                failure,
+                plane="thread",
+                **{
+                    k: v
+                    for k, v in kw.items()
+                    if isinstance(v, (int, float, str, bool))
+                },
+            )
         if failure is Failure.HOST_LEADER:
             # targeted KILL conditioned on the victim's CURRENT topology
             # role — leadership is per-epoch (lowest surviving rank of the

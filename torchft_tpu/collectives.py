@@ -39,6 +39,7 @@ import numpy as np
 
 from torchft_tpu import wire
 from torchft_tpu.communicator import Communicator, CommunicatorError
+from torchft_tpu.obs.spans import span as obs_span
 from torchft_tpu.quantization import (
     DEFAULT_ROW_SIZE,
     FP8,
@@ -581,19 +582,20 @@ def outer_sharded_sync(
         try:
             if contrib is None:
                 raise err or CommunicatorError("intra-host reduce failed")
-            delta_full = _outer_sharded_pipeline(
-                group,
-                contrib,
-                padded,
-                per,
-                unit,
-                update_cb,
-                num_participants,
-                should_quantize,
-                kind,
-                row_size,
-                tm,
-            )
+            with obs_span("outer_shard::pipeline"):
+                delta_full = _outer_sharded_pipeline(
+                    group,
+                    contrib,
+                    padded,
+                    per,
+                    unit,
+                    update_cb,
+                    num_participants,
+                    should_quantize,
+                    kind,
+                    row_size,
+                    tm,
+                )
         except BaseException as e:  # noqa: BLE001
             err = err or e
             delta_full = np.zeros(padded, dtype=np.float32)
@@ -721,9 +723,11 @@ def _outer_sharded_pipeline(
                     acc = np.sum(np.stack(gathered), axis=0)
                 acc *= inv
                 t0 = time.perf_counter()
-                delta = np.asarray(
-                    update_cb(my_base + c0, my_base + c1, acc), dtype=np.float32
-                )
+                with obs_span("outer_shard::chunk_update", chunk=ci):
+                    delta = np.asarray(
+                        update_cb(my_base + c0, my_base + c1, acc),
+                        dtype=np.float32,
+                    )
                 tm["update_s"] += time.perf_counter() - t0
             except BaseException as e:  # noqa: BLE001
                 err = err or e

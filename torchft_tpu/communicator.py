@@ -50,12 +50,33 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from torchft_tpu.futures import TimerHandle, schedule_timeout
+from torchft_tpu.obs.flight import FlightEvent, FlightRecorder
+from torchft_tpu.obs.spans import span as obs_span, spans_enabled
 from torchft_tpu.store import create_store_client
 from torchft_tpu import wire as wire_tags
 from torchft_tpu.wire import create_listener
 from torchft_tpu.work import DummyWork, Work
 
 logger = logging.getLogger(__name__)
+
+
+def _spanned(name: str):
+    """Wrap a hot method in an obs trace span — one truthiness check when
+    spans are disabled, a recorded wall-clock window when enabled."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            if not spans_enabled():
+                return fn(*args, **kwargs)
+            with obs_span(name):
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return deco
+
 
 Buffers = Union[np.ndarray, Sequence[np.ndarray]]
 
@@ -977,9 +998,13 @@ class _TcpMesh:
         host_id: Optional[str] = None,
         hier: Optional[str] = None,
         faults: Optional[_FaultProgram] = None,
+        flight: Optional[FlightRecorder] = None,
     ) -> None:
         self.rank = rank
         self.world_size = world_size
+        # flight recorder of the owning communicator (None when unattached):
+        # lane reconnects/failovers and env-armed fault programs record here
+        self._flight = flight
         self._aborted = threading.Event()
         # netem-style pacing (off unless TORCHFT_NET_EMU/GBPS/RTT_MS set)
         self._emu = _net_emu_from_env()
@@ -1005,6 +1030,13 @@ class _TcpMesh:
         self.faults: Optional[_FaultProgram] = (
             faults if faults is not None else _net_faults_from_env()
         )
+        if faults is None and self.faults is not None and self._flight:
+            # process-plane chaos arming: the fault program rode the spawn
+            # env (TORCHFT_NET_FAULTS); runtime arming records in
+            # arm_faults instead, so the two planes never double-record
+            self._flight.record(
+                FlightEvent.CHAOS_INJECT, via="env", armed=True
+            )
         import random as _random
 
         seed_raw = os.environ.get(NET_FAULT_SEED_ENV, "")
@@ -1571,6 +1603,7 @@ class _TcpMesh:
             off += _recv_some(memoryview(buf)[off:])
         return bytes(buf)
 
+    @_spanned("comm::lane_window")
     def exchange(
         self,
         sends: List[Tuple[int, int, memoryview]],
@@ -1983,6 +2016,10 @@ class _TcpMesh:
         )
         if self._try_reconnect(key, ctx, deadline):
             self.lane_reconnects += 1
+            if self._flight:
+                self._flight.record(
+                    FlightEvent.LANE_RECONNECT, peer=key[0], lane=key[1]
+                )
             logger.info("lane %s: reconnected in-epoch", key)
             return
         self._initiate_failover(key, ctx, exc)
@@ -2254,6 +2291,10 @@ class _TcpMesh:
             ctx.recv_q.setdefault(surv_key, []).extend(ent["recvs"])
         self.dead_lanes.setdefault(peer, set()).add(ln)
         self.lane_failovers += 1
+        if self._flight:
+            self._flight.record(
+                FlightEvent.LANE_FAILOVER, peer=peer, lane=ln, surv=ent["surv"]
+            )
         ctx.frame_gates.pop(key, None)
         logger.warning(
             "lane %s failed over: %d outstanding sub-frames re-routed to "
@@ -2579,6 +2620,10 @@ class TCPCommunicator(Communicator):
         # below (warm serving never yields).
         self._inflight_ops = 0
         self._inflight_lock = threading.Lock()
+        # flight recorder attachment point: the owning Manager sets this to
+        # its per-replica recorder; epoch lifecycle (configure / abort /
+        # poison) and the mesh's lane-recovery machinery record into it
+        self.flight: Optional[FlightRecorder] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -2607,15 +2652,17 @@ class TCPCommunicator(Communicator):
 
         mesh: Optional[_TcpMesh] = None
         if world_size > 1:
-            mesh = _TcpMesh(
-                store_addr,
-                rank,
-                world_size,
-                self._timeout_s,
-                host_id=self._host_id,
-                hier=self._hier,
-                faults=self._fault_override,
-            )
+            with obs_span("comm::rendezvous", epoch=epoch):
+                mesh = _TcpMesh(
+                    store_addr,
+                    rank,
+                    world_size,
+                    self._timeout_s,
+                    host_id=self._host_id,
+                    hier=self._hier,
+                    faults=self._fault_override,
+                    flight=self.flight,
+                )
 
         with self._lock:
             if self._epoch != epoch:
@@ -2634,6 +2681,16 @@ class TCPCommunicator(Communicator):
                 daemon=True,
             )
             self._op_thread.start()
+        if self.flight:
+            self.flight.set_comm_epoch(epoch)
+            self.flight.record(
+                FlightEvent.COMM_CONFIGURE,
+                comm_epoch=epoch,
+                quorum_id=quorum_id,
+                rank=rank,
+                world=world_size,
+                lanes=mesh.lanes if mesh is not None else 0,
+            )
         logger.info(
             "communicator configured: replica_id=%s rank=%d/%d quorum_id=%d",
             replica_id,
@@ -2662,8 +2719,45 @@ class TCPCommunicator(Communicator):
     def abort(self, reason: str = "aborted") -> None:
         """Unblock in-flight collectives and poison until reconfigure."""
         with self._lock:
+            newly_poisoned = self._errored is None
+            lane_summary = self._lane_summary_locked()
             self._abort_locked(reason)
+        if self.flight:
+            self.flight.record(FlightEvent.COMM_ABORT, reason=reason)
+        self._flight_poison(reason, newly_poisoned, lane_summary)
         logger.warning("communicator aborted: %s", reason)
+
+    def _lane_summary_locked(self) -> Dict[str, int]:
+        """Counter summary of the (dying) epoch's mesh, captured under the
+        lock BEFORE teardown clears it — the stall/fault evidence a
+        postmortem chains from injection to poison."""
+        mesh = self._mesh
+        if mesh is None:
+            return {}
+        return {
+            "stalls": sum(mesh.lane_stalls),
+            "reconnects": mesh.lane_reconnects,
+            "failovers": mesh.lane_failovers,
+            "faults_injected": mesh.faults_injected,
+        }
+
+    def _flight_poison(
+        self,
+        reason: str,
+        newly_poisoned: bool,
+        lane_summary: Dict[str, int],
+    ) -> None:
+        """Record the epoch poison (when an error actually latched) plus a
+        rate-limited flight dump.  Runs OUTSIDE every communicator lock:
+        dumps do file IO."""
+        flight = self.flight
+        if flight is None:
+            return
+        if newly_poisoned and reason != "shutdown":
+            flight.record(
+                FlightEvent.COMM_POISON, reason=reason, **lane_summary
+            )
+            flight.maybe_dump("comm_poison")
 
     def _abort_locked(self, reason: str) -> None:
         if self._errored is None:
@@ -2721,6 +2815,13 @@ class TCPCommunicator(Communicator):
         mesh = self._mesh
         if mesh is not None:
             mesh.faults = prog if prog is not None else _net_faults_from_env()
+        if self.flight:
+            self.flight.record(
+                FlightEvent.CHAOS_INJECT,
+                via="arm_faults",
+                armed=prog is not None,
+                spec=spec if isinstance(spec, str) else None,
+            )
         logger.info(
             "fault program %s", "armed" if prog is not None else "disarmed"
         )
@@ -2842,7 +2943,12 @@ class TCPCommunicator(Communicator):
             with self._lock:
                 if self._epoch != epoch:
                     return
+                newly_poisoned = self._errored is None
+                lane_summary = self._lane_summary_locked()
                 self._abort_locked(reason)
+            if self.flight:
+                self.flight.record(FlightEvent.COMM_ABORT, reason=reason)
+            self._flight_poison(reason, newly_poisoned, lane_summary)
             logger.warning("communicator aborted: %s", reason)
 
         threading.Thread(target=_do, name="tpuft_comm_abort", daemon=True).start()
@@ -2872,7 +2978,8 @@ class TCPCommunicator(Communicator):
             )
             self._op_started()
             try:
-                result = fn()
+                with obs_span("comm::op", epoch=epoch):
+                    result = fn()
             except BaseException as e:  # noqa: BLE001
                 # A fail-stop PEER death on a point-to-point byte op (dead
                 # socket — the striped-heal failover case) stays scoped to
@@ -2884,6 +2991,8 @@ class TCPCommunicator(Communicator):
                 # leave THIS pair's stream desynchronized on a live socket,
                 # and op timeouts already abort via the watchdog above.
                 peer_scoped = peer_fail_stop and isinstance(e, PeerGoneError)
+                latched = False
+                lane_summary: Dict[str, int] = {}
                 if not peer_scoped:
                     with self._lock:
                         if self._epoch == epoch and self._errored is None:
@@ -2892,6 +3001,10 @@ class TCPCommunicator(Communicator):
                                 if isinstance(e, Exception)
                                 else RuntimeError(str(e))
                             )
+                            latched = True
+                            lane_summary = self._lane_summary_locked()
+                if latched:
+                    self._flight_poison(str(e), True, lane_summary)
                 fut.set_exception(e)
             else:
                 fut.set_result(result)

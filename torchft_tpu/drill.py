@@ -1004,10 +1004,15 @@ def _device_loss_drill(
             survivors = [r for r in actives if r is not victim]
             fleet = survivors
             deadline = time.monotonic() + 240.0
+            # wait for the victim's FENCED vote too, not just the
+            # survivors' step budget: the victim consumes the armed loss
+            # at its next step boundary, and a scheduling hiccup can leave
+            # that one step in flight after faster survivors finish —
+            # asserting then would read mid_commit before it exists
             while (
                 min(r.commits for r in survivors) < steps
-                and time.monotonic() < deadline
-            ):
+                or mid_commit[0] is None
+            ) and time.monotonic() < deadline:
                 time.sleep(0.05)
             stop.set()
             for t in threads:
@@ -1319,6 +1324,438 @@ def joint_ft_spmd_drill(
         "heal_source_killed": chaos_fired.is_set(),
         "heal_timings": dict(heal_timings),
     }
+
+
+def postmortem_drill(
+    num_replicas: int = 3,
+    steps: int = 10,
+    arm_at_step: int = 3,
+    # modest per-op timeout: after the kill, one survivor's collective can
+    # stall on a live-but-silent lane until the op watchdog fires, so this
+    # bounds the poison→shrink leg of the drill's wall clock
+    timeout_s: float = 6.0,
+    tier: str = "python",
+    payload_elems: int = 200_000,
+    fault_spec: str = "loss:0.02,reset:0.01",
+    lanes: int = 2,
+    out_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Chaos postmortem drill: the flight-recorder acceptance gate.
+
+    A real fleet (lighthouse + one Manager per replica, threads in one
+    process) commits steps while the drill injects a gray failure and a
+    kill, then the SURVIVORS' flight dumps (plus the victim's shutdown
+    dump, the restarted victim's heal dump, and the lighthouse's
+    coordination dump) are merged by ``scripts/flight_merge.py`` and the
+    causal chain is asserted IN ORDER on the aligned fleet timeline:
+
+    ``python`` tier: ``CHAOS_INJECT`` (NET_FLAKY armed fleet-wide) → lane
+    distress (``LANE_RECONNECT`` events, or injected-fault/stall counters
+    riding the poison event) → ``COMM_POISON`` on a survivor (the kill
+    severs the victim's sockets mid-collective) → ``QUORUM_ADOPT`` of the
+    shrunk quorum, correlated by identical ``(quorum_id, step)`` across
+    survivors → heal phases (``HEAL_RECV_END`` on the restarted victim,
+    ``HEAL_SEND_BEGIN`` on a survivor).
+
+    ``cpp`` tier: the native data plane has no fault injection yet
+    (ROADMAP item 5), so the chain starts at the kill —
+    ``CHAOS_INJECT(kill)`` → poison → shrink → heal — and additionally
+    asserts the merged dump contains NATIVE ring events
+    (``COMM_CONFIGURE`` drained over ``tpuft_comm_flight_drain``).
+
+    Returns the chain timestamps and merge facts (asserts internally)."""
+    import glob
+    import tempfile
+
+    sys_path_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    )
+    import sys
+
+    if sys_path_dir not in sys.path:
+        sys.path.insert(0, sys_path_dir)
+    import flight_merge
+
+    from torchft_tpu.chaos import ChaosController, Failure, ThreadReplica
+    from torchft_tpu.lighthouse import LighthouseServer
+    from torchft_tpu.manager import Manager
+
+    assert tier in ("python", "cpp"), tier
+    assert num_replicas >= 3, "postmortem drills need a surviving majority"
+    if tier == "cpp":
+        from torchft_tpu import native
+
+        if not native.available():
+            raise RuntimeError("native tier unavailable")
+
+        def make_comm():
+            return native.CppCommunicator(timeout_s=timeout_s)
+    else:
+        from torchft_tpu.communicator import TCPCommunicator
+
+        def make_comm():
+            return TCPCommunicator(timeout_s=timeout_s)
+
+    tmp_ctx = None
+    if out_dir is None:
+        tmp_ctx = tempfile.TemporaryDirectory(prefix="tpuft_flight_")
+        out_dir = tmp_ctx.name
+    saved_env = {
+        k: os.environ.get(k)
+        for k in (
+            "TORCHFT_FLIGHT_DIR",
+            "TORCHFT_RING_LANES",
+            "TORCHFT_NET_FAULT_SEED",
+        )
+    }
+    os.environ["TORCHFT_FLIGHT_DIR"] = out_dir
+    os.environ["TORCHFT_NET_FAULT_SEED"] = "11"
+    if tier == "python":
+        os.environ["TORCHFT_RING_LANES"] = str(lanes)
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=num_replicas - 1,
+        join_timeout_ms=300,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=1500,
+    )
+    rng = np.random.default_rng(5)
+    grad = rng.normal(size=payload_elems).astype(np.float32)
+    stop = threading.Event()
+
+    class _Rep:
+        def __init__(self, idx: int, life: int = 0) -> None:
+            self.idx = idx
+            self.life = life
+            self.params = np.zeros(payload_elems, dtype=np.float32)
+            self.comm = make_comm()
+            self.manager = Manager(
+                comm=self.comm,
+                load_state_dict=self._load,
+                state_dict=self._save,
+                min_replica_size=num_replicas - 1,
+                replica_id=f"pm_{idx}" + ("r" * life),
+                lighthouse_addr=lighthouse.local_address(),
+                timeout=timeout_s,
+                quorum_timeout=timeout_s,
+                connect_timeout=timeout_s,
+                init_sync=False,
+            )
+            self.commits = 0
+            self.kill_flag = threading.Event()
+            self.healed = False
+
+        def _save(self) -> Dict[str, Any]:
+            return {"params": self.params.copy()}
+
+        def _load(self, sd: Dict[str, Any]) -> None:
+            self.params = np.asarray(sd["params"], dtype=np.float32).copy()
+            self.healed = True
+
+        def loop(self) -> None:
+            # no per-replica step bound: the MAIN thread ends the drill via
+            # ``stop`` once the rejoined victim has healed and committed —
+            # a fixed bound would let fast survivors exit (and stop
+            # issuing the quorum RPCs the rejoiner's heal needs) before
+            # the rejoin lands
+            while not stop.is_set():
+                try:
+                    self.manager.start_quorum()
+                    if self.kill_flag.is_set():
+                        # die AFTER joining the round's quorum: the peers'
+                        # collective is then in flight against this
+                        # replica's sockets, so severing them poisons the
+                        # survivors' epoch — the postmortem's poison link.
+                        # The shutdown dump preserves this incarnation's
+                        # ring.
+                        try:
+                            self.manager.wait_quorum()
+                        except Exception:  # noqa: BLE001 — dying anyway
+                            pass
+                        self.manager.shutdown()
+                        return
+                    work = self.manager.allreduce(grad.copy())
+                    avg = work.wait(timeout=timeout_s)
+                    ok = self.manager.should_commit()
+                except Exception:  # noqa: BLE001 — a failed step, not a crash
+                    ok = False
+                if ok and not stop.is_set():
+                    self.params += avg
+                    self.commits += 1
+
+    replicas = [_Rep(i) for i in range(num_replicas)]
+    victim = replicas[num_replicas - 1]
+    chaos = ChaosController(
+        [ThreadReplica(f"pm_{r.idx}", r) for r in replicas]
+    )
+    threads = [
+        threading.Thread(target=r.loop, daemon=True) for r in replicas
+    ]
+    report: Dict[str, Any] = {"tier": tier, "flight_dir": out_dir}
+    victim2: Optional[_Rep] = None
+    victim2_thread: Optional[threading.Thread] = None
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 120.0
+        while (
+            min(r.commits for r in replicas) < arm_at_step
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert min(r.commits for r in replicas) >= arm_at_step, (
+            "fleet never reached the arming step"
+        )
+
+        if tier == "python":
+            # phase 1: flaky links fleet-wide; recovery stays in-epoch but
+            # leaves fault/stall/reconnect evidence in every recorder
+            for handle in chaos.replicas:
+                chaos.inject(
+                    Failure.NET_FLAKY, victim=handle, spec=fault_spec
+                )
+            flaky_target = min(steps, arm_at_step + 2)
+            deadline = time.monotonic() + 120.0
+            while (
+                min(r.commits for r in replicas) < flaky_target
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            assert min(r.commits for r in replicas) >= flaky_target, (
+                "fleet stalled under the flaky link"
+            )
+
+        # phase 2: kill the victim mid-run — survivors poison, the quorum
+        # shrinks, and the restarted incarnation must heal back in
+        survivors = [r for r in replicas if r is not victim]
+        commits_at_kill = min(r.commits for r in survivors)
+        chaos.inject(Failure.KILL, victim=chaos.replicas[victim.idx])
+        deadline = time.monotonic() + 180.0
+        while (
+            min(r.commits for r in survivors) < commits_at_kill + 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert min(r.commits for r in survivors) >= commits_at_kill + 2, (
+            "survivors never resumed after the kill"
+        )
+
+        # phase 3: the victim's replacement rejoins behind the fleet and
+        # heals (HEAL_RECV on it, HEAL_SEND on a survivor)
+        victim2 = _Rep(victim.idx, life=1)
+        victim2_thread = threading.Thread(target=victim2.loop, daemon=True)
+        victim2_thread.start()
+        deadline = time.monotonic() + 180.0
+        fleet = survivors + [victim2]
+        # the drill is over once the rejoiner has HEALED and committed at
+        # least twice with the fleet (and everyone has cleared the step
+        # target) — the main thread is the only exit path
+        while (
+            not (
+                victim2.healed
+                and victim2.commits >= 2
+                and min(r.manager.current_step() for r in fleet) >= steps
+            )
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        stop.set()
+        for t in threads + [victim2_thread]:
+            t.join(timeout=2 * timeout_s + 10.0)
+        assert victim2.healed, "restarted victim never healed"
+        assert victim2.commits >= 2, (
+            f"restarted victim never committed with the fleet "
+            f"({victim2.commits} commits)"
+        )
+        assert all(
+            r.manager.current_step() >= steps for r in fleet
+        ), f"fleet stalled: {[r.manager.current_step() for r in fleet]}"
+
+        # final dumps: every live recorder's complete ring + the
+        # lighthouse's coordination feed (QUORUM_ISSUE anchors)
+        for r in fleet:
+            r.manager._flight.dump("drill_end")
+        lighthouse._flight.dump("drill_end")
+
+        merged = flight_merge.merge_flight_dumps(
+            sorted(glob.glob(os.path.join(out_dir, "flight_*.jsonl")))
+        )
+        events = merged["events"]
+        report["replicas_merged"] = len(merged["replicas"])
+        report["events_merged"] = len(events)
+        report["anchors"] = merged["anchors"]
+        assert len(merged["replicas"]) >= num_replicas + 1, merged["replicas"]
+        assert merged["anchors"] > 0, "no shared (quorum_id, step) anchors"
+
+        survivor_prefixes = [f"pm_{r.idx}" for r in survivors]
+
+        def _events_of(prefix: str) -> List[Dict[str, Any]]:
+            # one replica's events in ITS OWN recording order (seq is
+            # strictly monotonic per recorder incarnation) — causal order
+            # within a replica needs no clock alignment at all.  Replica
+            # ids are "{prefix}:{uuid}/{rank}", so match on the ":"
+            # boundary — a bare startswith would fold pm_10 into pm_1
+            own = [
+                e
+                for e in events
+                if e.get("replica_id", "").startswith(prefix + ":")
+            ]
+            own.sort(key=lambda e: e.get("seq", 0))
+            return own
+
+        # -- the causal chain -------------------------------------------
+        # cross-replica facts (existence + (quorum_id, step) correlation)
+        # come from the merged timeline; ORDER is asserted per replica on
+        # its own seq-ordered ring, which stays exact under arbitrary
+        # scheduler load — the aligned timestamps are reported for the
+        # human postmortem view.
+        injects = [e for e in events if e["name"] == "CHAOS_INJECT"]
+        assert injects, "no CHAOS_INJECT recorded"
+        report["t_inject"] = min(e["t_aligned"] for e in injects)
+
+        if tier == "python":
+            distress = [
+                e
+                for e in events
+                if e["name"] in ("LANE_RECONNECT", "LANE_FAILOVER")
+                or (
+                    e["name"] == "COMM_POISON"
+                    and (e.get("faults_injected", 0) or e.get("stalls", 0))
+                )
+            ]
+            assert distress, (
+                "no lane-distress evidence (reconnects / injected faults / "
+                "stalls) after the injection"
+            )
+            report["t_distress"] = min(e["t_aligned"] for e in distress)
+
+        # every survivor adopted a shrunk quorum, and they all adopted the
+        # SAME (quorum_id, step) — the correlation key the merge aligns on
+        shrink_by_survivor: Dict[str, List[Dict[str, Any]]] = {}
+        for prefix in survivor_prefixes:
+            own = _events_of(prefix)
+            shrinks = [
+                e
+                for e in own
+                if e["name"] == "QUORUM_ADOPT"
+                and e.get("world") == num_replicas - 1
+            ]
+            assert shrinks, f"{prefix} never adopted the shrunk quorum"
+            shrink_by_survivor[prefix] = shrinks
+        shared_keys = set.intersection(
+            *(
+                {(e["quorum_id"], e["step"]) for e in shrinks}
+                for shrinks in shrink_by_survivor.values()
+            )
+        )
+        assert shared_keys, (
+            "shrunk-quorum adoption not correlated across survivors: "
+            f"{ {p: [(e['quorum_id'], e['step']) for e in s] for p, s in shrink_by_survivor.items()} }"
+        )
+        report["shrink_key"] = sorted(shared_keys)[0]
+
+        # at least one survivor's OWN ring shows poison strictly before
+        # its shrunk-quorum adoption (the kill severed its in-flight
+        # collective; a survivor idling between collectives may reconfigure
+        # without ever poisoning)
+        ordered_chain = []
+        t_poisons = []
+        for prefix in survivor_prefixes:
+            own = _events_of(prefix)
+            names = [e["name"] for e in own]
+            poisons = [e for e in own if e["name"] == "COMM_POISON"]
+            t_poisons += [e["t_aligned"] for e in poisons]
+            if not poisons:
+                continue
+            first_poison_idx = names.index("COMM_POISON")
+            shrink_idx = next(
+                (
+                    i
+                    for i, e in enumerate(own)
+                    if e["name"] == "QUORUM_ADOPT"
+                    and (e["quorum_id"], e["step"]) in shared_keys
+                ),
+                None,
+            )
+            if shrink_idx is not None and first_poison_idx < shrink_idx:
+                ordered_chain.append(prefix)
+        assert t_poisons, "no survivor COMM_POISON after the kill"
+        assert ordered_chain, (
+            "no survivor's own ring shows poison -> shrunk-quorum adoption"
+        )
+        report["t_poison"] = min(t_poisons)
+        report["t_shrink"] = min(
+            e["t_aligned"]
+            for shrinks in shrink_by_survivor.values()
+            for e in shrinks
+        )
+
+        # heal: the restarted victim fetched (its own ring orders ADOPT ->
+        # HEAL_RECV_BEGIN -> HEAL_RECV_END), and a survivor served AFTER
+        # its shrunk-quorum adoption (its own ring's order)
+        victim2_own = _events_of(f"pm_{victim.idx}r")
+        recv_ends = [
+            e for e in victim2_own if e["name"] == "HEAL_RECV_END"
+        ]
+        assert recv_ends, "restarted victim recorded no HEAL_RECV_END"
+        report["t_heal"] = recv_ends[0]["t_aligned"]
+        served = False
+        for prefix in survivor_prefixes:
+            own = _events_of(prefix)
+            shrink_idx = next(
+                (
+                    i
+                    for i, e in enumerate(own)
+                    if e["name"] == "QUORUM_ADOPT"
+                    and (e["quorum_id"], e["step"]) in shared_keys
+                ),
+                None,
+            )
+            if shrink_idx is None:
+                continue
+            if any(
+                e["name"] == "HEAL_SEND_BEGIN"
+                for e in own[shrink_idx + 1 :]
+            ):
+                served = True
+                break
+        assert served, (
+            "no survivor recorded HEAL_SEND_BEGIN after the shrunk quorum"
+        )
+
+        if tier == "cpp":
+            native_events = [
+                e
+                for e in events
+                if e.get("native") and e["name"] == "COMM_CONFIGURE"
+            ]
+            assert native_events, (
+                "no native C-ring events merged into the dumps"
+            )
+            report["native_events"] = len(native_events)
+        report["chain_ok"] = True
+    finally:
+        stop.set()
+        join_list = threads + (
+            [victim2_thread] if victim2_thread is not None else []
+        )
+        for t in join_list:
+            t.join(timeout=5.0)
+        for r in replicas + ([victim2] if victim2 is not None else []):
+            try:
+                r.manager.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        lighthouse.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()
+    return report
 
 
 def coord_churn_drill(

@@ -101,6 +101,18 @@ _k("TORCHFT_LOG_DIR", "str", "unset",
    "Directory for JSONL metrics logs (torchft_quorums / torchft_heals); enables logging when set")
 _k("TORCHFT_TRACE_DIR", "str", "unset",
    "Directory for per-epoch chrome-trace dumps (off when unset)")
+_k("TORCHFT_FLIGHT_EVENTS", "int", "4096",
+   "Flight-recorder ring capacity (typed events per replica); 0 disables recording entirely")
+_k("TORCHFT_FLIGHT_DIR", "str", "unset",
+   "Directory flight dumps land in as flight_{replica_id}.jsonl (poison / error-funnel / SIGUSR2 / atexit / shutdown triggers); unset disables file dumps")
+_k("TORCHFT_FLIGHT_SPANS", "bool", "0",
+   "Collect per-step trace spans (quorum rpc, collectives, lane windows, heal) for Chrome-trace export")
+_k("TORCHFT_FLIGHT_DUMP_MIN_S", "float", "1.0",
+   "Rate limit between automatic flight dumps (a poison storm must not turn into an fsync storm)")
+_k("TORCHFT_METRICS", "bool", "1",
+   "Serve the Prometheus-text /metrics endpoint on the lighthouse and every ManagerServer")
+_k("TORCHFT_METRICS_TTL_S", "float", "0.5",
+   "ManagerServer /metrics snapshot TTL: scrape storms rebuild the sample set at most once per TTL")
 # --- data plane: lanes / framing / topology ---------------------------------
 _k("TORCHFT_RING_LANES", "str", "auto",
    "TCP lanes per peer for striped collectives (auto = profile-derived; must be uniform)")
@@ -274,6 +286,10 @@ _k("TPUFT_BENCH_SKIP_COORD", "bool", "0",
    "Skip the coordination-plane scale phase", "bench")
 _k("TPUFT_BENCH_SKIP_DEGRADED", "bool", "0",
    "Skip the degraded-mode (device-loss) bench phase", "bench")
+_k("TPUFT_BENCH_SKIP_OBS", "bool", "0",
+   "Skip the observability-overhead bench phase", "bench")
+_k("TPUFT_BENCH_OBS_STEPS", "int", "40",
+   "Measured steps per leg of the observability-overhead phase", "bench")
 _k("TPUFT_BENCH_COORD_REPLICAS", "int", "120 cpu / 500 tpu",
    "Simulated replicas driven by the coordination scale phase", "bench")
 _k("TPUFT_BENCH_PROBE_TIMEOUT_S", "float", "180",

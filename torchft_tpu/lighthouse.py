@@ -40,6 +40,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from torchft_tpu import knobs
+from torchft_tpu.obs import metrics as obs_metrics
+from torchft_tpu.obs.flight import FlightEvent, FlightRecorder
 from torchft_tpu.wire import (
     ROLE_ACTIVE,
     ROLE_SPARE,
@@ -63,9 +65,11 @@ from torchft_tpu.wire import (
     manager_quorum_wire_version,
     quorum_digest,
     raise_if_error,
+    read_http_path,
     recv_frame,
     send_error,
     send_frame,
+    send_http_response,
 )
 
 logger = logging.getLogger(__name__)
@@ -323,6 +327,9 @@ class _State:
     degraded_evicted_prev: set = field(default_factory=set)
     degraded_evictions_total: int = 0
     swaps_total: int = 0
+    # wounded replicas swapped out THIS computation (reset alongside
+    # promoted_now) — the flight recorder's DEGRADED_SWAP feed
+    swapped_now: List[str] = field(default_factory=list)
 
 
 # health entries stop counting as straggler-median "reporters" after this
@@ -444,6 +451,7 @@ def _promote_spares(
     minus the shrink.  Mutates ``state`` (tick path only; ``_status`` calls
     ``quorum_compute`` with ``allow_promote=False``)."""
     state.promoted_now = []
+    state.swapped_now = []
     if not _spare_promote_enabled() or state.prev_quorum is None:
         return
     if any(d.member.shrink_only for d in state.participants.values()):
@@ -549,6 +557,7 @@ def _promote_spares(
         )
         healthy_replicas.add(rid)
         state.promoted_now.append(rid)
+        state.swapped_now.append(wid)
         state.promotions_total += 1
         state.swaps_total += 1
         logger.warning(
@@ -833,6 +842,15 @@ class LighthouseServer:
         )
         self._status_cache_lock = threading.Lock()
         self.status_lock_acquires = 0
+        # /metrics rides the SAME TTL-cached snapshot; the rendered text is
+        # cached per snapshot build, so a scrape storm costs neither a
+        # state-lock acquire nor a re-render
+        self._metrics_cache: Tuple[float, bytes] = (float("-inf"), b"")
+        self._metrics_cache_lock = threading.Lock()
+        # coordination-plane flight recorder: quorum issues, promotions,
+        # swaps and evictions land here (replica_id "lighthouse" in merged
+        # fleet timelines)
+        self._flight = FlightRecorder(replica_id="lighthouse")
         # inbound RPC counters by MsgType (the aggregation win is measured
         # here: agg flushes replace per-member heartbeat RPCs)
         self._inbound_counts: Dict[int, int] = {}
@@ -980,6 +998,23 @@ class LighthouseServer:
         state.prev_quorum = quorum
         state.participants.clear()
         state.hold_since.clear()  # fresh prev quorum, fresh hold anchors
+        # flight feed: the coordination plane's side of the fleet timeline
+        # (record() is a lock-free deque append — safe under the big lock)
+        issue_step = max((m.step for m in quorum.participants), default=-1)
+        self._flight.set_context(step=issue_step, quorum_id=state.quorum_id)
+        self._flight.record(
+            FlightEvent.QUORUM_ISSUE,
+            world=len(quorum.participants),
+            spares=len(quorum.spares),
+        )
+        for rid in state.promoted_now:
+            self._flight.record(FlightEvent.SPARE_PROMOTE, replica=rid)
+        for rid in state.swapped_now:
+            self._flight.record(FlightEvent.DEGRADED_SWAP, replica=rid)
+        for rid in newly_shed:
+            self._flight.record(FlightEvent.EVICT_SLOW, replica=rid)
+        for rid in newly_floor_shed:
+            self._flight.record(FlightEvent.DEGRADED_EVICT, replica=rid)
         # delta-base ring: waiters advertising this quorum's digest on
         # later rounds receive membership deltas instead of full snapshots
         digest = quorum_digest(quorum)
@@ -1480,18 +1515,106 @@ class LighthouseServer:
             out[name] = n
         return out
 
+    def _metrics_text(self) -> bytes:
+        """Prometheus text built from the SAME TTL-cached status snapshot
+        (`_status_snapshot`): a scrape storm acquires the quorum state lock
+        at most once per ``TORCHFT_STATUS_TTL_S`` — identical contract to
+        /status(.json) — and the rendered text is cached per snapshot
+        build, keyed on the rebuild's own clock stamp."""
+        snap, _raw = self._status_snapshot()
+        key = snap["now_monotonic"]
+        with self._metrics_cache_lock:
+            cached_key, cached = self._metrics_cache
+            if cached and cached_key == key:
+                return cached
+            rendered = self._render_metrics(snap).encode()
+            self._metrics_cache = (key, rendered)
+            return rendered
+
+    @staticmethod
+    def _render_metrics(snap: dict) -> str:
+        sample = obs_metrics.metric_sample
+        samples = [
+            sample("torchft_lh_quorum_id", snap["quorum_id"]),
+            sample("torchft_lh_max_step", snap["max_step"]),
+            sample("torchft_lh_participants", snap["num_participants"]),
+            sample("torchft_lh_heartbeating", len(snap["heartbeats"])),
+            sample("torchft_lh_spares", len(snap["spares"])),
+            sample(
+                "torchft_lh_lagging_replicas", len(snap["lagging_replicas"])
+            ),
+            sample("torchft_lh_heal_sources", snap["num_heal_sources"]),
+            sample("torchft_lh_promotions_total", snap["promotions_total"]),
+            sample("torchft_lh_evictions_total", snap["evictions_total"]),
+            sample(
+                "torchft_lh_degraded_evictions_total",
+                snap["degraded_evictions_total"],
+            ),
+            sample("torchft_lh_swaps_total", snap["swaps_total"]),
+            sample(
+                "torchft_lh_status_rebuilds_total", snap["status_rebuilds"]
+            ),
+            sample(
+                "torchft_lh_aggregated_members", snap["aggregated_members"]
+            ),
+        ]
+        for rid, age in sorted(snap["heartbeats"].items()):
+            samples.append(
+                sample(
+                    "torchft_lh_heartbeat_age_seconds",
+                    age,
+                    {"replica_id": rid},
+                )
+            )
+        for p in snap["participants"]:
+            labels = {"replica_id": p["replica_id"]}
+            samples.append(sample("torchft_lh_replica_step", p["step"], labels))
+            samples.append(
+                sample("torchft_lh_replica_capacity", p["capacity"], labels)
+            )
+        for rid, h in sorted(snap["health"].items()):
+            labels = {"replica_id": rid}
+            samples.append(
+                sample("torchft_lh_stall_rate", h["stall_rate"], labels)
+            )
+            samples.append(
+                sample(
+                    "torchft_lh_replica_flagged",
+                    1 if h["flagged"] else 0,
+                    labels,
+                )
+            )
+        for sp in snap["spares"]:
+            samples.append(
+                sample(
+                    "torchft_lh_spare_warm_lag_steps",
+                    sp["warm_lag_steps"],
+                    {"replica_id": sp["replica_id"]},
+                )
+            )
+        for msg_type, count in snap["rpc_counts"].items():
+            samples.append(
+                sample(
+                    "torchft_lh_rpc_inbound_total",
+                    count,
+                    {"msg_type": msg_type},
+                )
+            )
+        for agg_id, age in snap["aggregators"].items():
+            samples.append(
+                sample(
+                    "torchft_lh_agg_flush_age_seconds",
+                    age,
+                    {"agg_id": agg_id},
+                )
+            )
+        return obs_metrics.render(samples)
+
     def _handle_http(self, conn: socket.socket) -> None:
         """Minimal dashboard (``templates/status.html`` analog)."""
-        conn.settimeout(5.0)
-        data = b""
-        while b"\r\n\r\n" not in data:
-            chunk = conn.recv(4096)
-            if not chunk:
-                return
-            data += chunk
-        request_line = data.split(b"\r\n", 1)[0].decode("latin-1")
-        parts = request_line.split()
-        path = parts[1] if len(parts) >= 2 else "/"
+        path = read_http_path(conn)
+        if path is None:
+            return
 
         if path.startswith("/replica/") and path.endswith("/kill"):
             replica_id = path[len("/replica/") : -len("/kill")]
@@ -1502,17 +1625,18 @@ class LighthouseServer:
         elif path == "/status.json":
             body = self._status_json()
             status, ctype = "200 OK", "application/json"
+        elif path == "/metrics":
+            if knobs.get_bool("TORCHFT_METRICS", True):
+                body = self._metrics_text()
+                status = "200 OK"
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                body = b"metrics disabled\n"
+                status, ctype = "404 Not Found", "text/plain"
         else:
             body = self._render_status_html().encode()
             status, ctype = "200 OK", "text/html; charset=utf-8"
-        resp = (
-            f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
-            f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
-        ).encode() + body
-        try:
-            conn.sendall(resp)
-        except OSError:
-            pass
+        send_http_response(conn, status, ctype, body)
 
     def _kill_replica(self, replica_id: str) -> Tuple[bool, str]:
         """Dashboard kill button → Kill RPC at the replica's manager

@@ -38,6 +38,8 @@ import numpy as np
 
 from torchft_tpu import knobs
 from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.obs.flight import FlightEvent, FlightRecorder, flight_dir
+from torchft_tpu.obs.spans import span as obs_span
 from torchft_tpu.observability import QuorumTracer, traced
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.communicator import Communicator, ReduceOp
@@ -145,6 +147,10 @@ class Manager:
         self.quorum_logger = logging.getLogger("torchft_quorums")
         self.commits_logger = logging.getLogger("torchft_commits")
         self.errors_logger = logging.getLogger("torchft_errors")
+        # per-replica flight recorder (obs/flight.py): the manager state
+        # machine, the communicator's epoch lifecycle, and the heal path
+        # all record into this ring; the replica id is stamped once known
+        self._flight = FlightRecorder(replica_id=replica_id or "")
 
         self._load_state_dict_fns: Dict[str, Callable[[object], None]] = {}
         self._user_state_dicts: Dict[str, Callable[[], object]] = {}
@@ -162,6 +168,10 @@ class Manager:
 
             comm = tier_mod.make_communicator(timeout_s=self._timeout)
         self._comm = comm
+        # attach the recorder to the data plane: epoch configure/abort/
+        # poison and lane recovery record into the same per-replica ring
+        # (a plain attribute — every tier's communicator carries it)
+        self._comm.flight = self._flight
         self._min_replica_size = min_replica_size
         self._use_async_quorum = use_async_quorum
         self._init_sync = init_sync
@@ -296,6 +306,7 @@ class Manager:
             # test hook: fully mocked control plane (``manager_test.py:41-82``)
             self._client = _manager_client
             self._replica_id = replica_id or "testing"
+            self._flight.set_replica_id(self._replica_id)
             self._store: Optional[StoreClient] = None
             return
 
@@ -357,6 +368,10 @@ class Manager:
                 # round) and, while < 1, direct heartbeats — read live so
                 # complete_relower takes effect on the next beat
                 capacity_fn=lambda: self._capacity,
+                # /metrics provider: per-replica gauges from the same
+                # registry that feeds last_quorum_timings (declared names
+                # only — obs/metrics.py is the single source of truth)
+                metrics_fn=self._metrics_snapshot,
             )
             # idle-priority warm serving: spare chunk fetches yield to live
             # collectives when the communicator exposes a busy probe
@@ -370,6 +385,7 @@ class Manager:
         self._replica_id = self._store.get(
             REPLICA_ID_KEY, timeout=self._connect_timeout
         ).decode()
+        self._flight.set_replica_id(f"{self._replica_id}/{self._group_rank}")
         self._client = ManagerClient(addr, connect_timeout=self._connect_timeout)
         self._logger = _ManagerLogger(self, self._replica_id, self._group_rank)
 
@@ -441,6 +457,43 @@ class Manager:
             rx_bytes=base["rx_bytes"] + sum(live.get("lane_rx_bytes") or []),
         )
 
+    # mapping from last_quorum_timings keys to their declared /metrics
+    # names (obs/metrics.py registry; the ftlint metrics-registry checker
+    # pins every literal below to a declaration)
+    _TIMING_METRICS = (
+        ("quorum_rpc_s", "torchft_mgr_quorum_rpc_seconds"),
+        ("configure_s", "torchft_mgr_configure_seconds"),
+        ("heal_send_s", "torchft_mgr_heal_send_seconds"),
+        ("heal_recv_s", "torchft_mgr_heal_recv_seconds"),
+        ("heal_bytes_per_sec", "torchft_mgr_heal_bytes_per_sec"),
+        ("ring_lanes", "torchft_mgr_ring_lanes"),
+        ("outer_shard_overlap_ratio", "torchft_mgr_outer_shard_overlap_ratio"),
+    )
+
+    def _metrics_snapshot(self) -> Dict[str, float]:
+        """Per-replica /metrics gauges for the ManagerServer endpoint —
+        the same registry that feeds ``last_quorum_timings``.  Racy reads
+        are fine: a scrape tolerates one stale value."""
+        out: Dict[str, float] = {
+            "torchft_mgr_step": float(self._step),
+            "torchft_mgr_quorum_id": float(self._quorum_id),
+            "torchft_mgr_capacity": float(self._capacity),
+            "torchft_mgr_batches_committed_total": float(
+                self._batches_committed
+            ),
+            "torchft_mgr_commit_failures": float(self._commit_failures),
+            "torchft_mgr_flight_events": float(len(self._flight)),
+            "torchft_mgr_flight_dumps_total": float(
+                self._flight.dumps_total
+            ),
+        }
+        timings = self.last_quorum_timings
+        for key, name in self._TIMING_METRICS:
+            value = timings.get(key)
+            if value is not None:
+                out[name] = float(value)
+        return out
+
     # ------------------------------------------------------------------
     # hot spares (warm channels + promotion handshake)
     # ------------------------------------------------------------------
@@ -456,6 +509,7 @@ class Manager:
         promotion) and runs the normal train-loop state machine."""
         from torchft_tpu.wire import ROLE_ACTIVE
 
+        self._flight.record(FlightEvent.SPARE_PROMOTE, step=self._step)
         self._role = "active"
         if self._manager_server is not None:
             self._manager_server.role = ROLE_ACTIVE
@@ -558,6 +612,7 @@ class Manager:
         nor the new layout, and a commit landing in that window would fork
         it from the fleet.  Idempotent; crash-safe by construction (a
         replica that dies mid-relower simply never voted commit)."""
+        self._flight.record(FlightEvent.RELOWER_BEGIN, step=self._step)
         self._relower_pending = True
 
     def complete_relower(self, capacity: float) -> None:
@@ -584,6 +639,9 @@ class Manager:
             )
         self._capacity = capacity
         self._relower_pending = False
+        self._flight.record(
+            FlightEvent.RELOWER_COMPLETE, step=self._step, capacity=capacity
+        )
         self._logger.info(
             f"re-lower complete: running at capacity {capacity:.3f}"
         )
@@ -642,6 +700,10 @@ class Manager:
             else ExceptionWithTraceback(e)
         )
         self._errored = wrapped
+        self._flight.record(
+            FlightEvent.ERROR, step=self._step, error=str(e)[:200]
+        )
+        self._flight.maybe_dump("error_funnel")
         self.errors_logger.info(
             "",
             extra={
@@ -702,6 +764,8 @@ class Manager:
 
         self._errored = None
         self._healing = False
+        self._flight.set_context(step=self._step)
+        self._flight.record(FlightEvent.QUORUM_START, step=self._step)
         # drop stale works from a step the caller abandoned without voting
         with self._pending_works_lock:
             self._pending_works.clear()
@@ -752,15 +816,16 @@ class Manager:
         timings: Dict[str, float] = {}
         self.last_quorum_timings = timings
         t0 = time.monotonic()
-        quorum = self._client._quorum(
-            group_rank=self._group_rank,
-            step=self._step,
-            checkpoint_metadata=self._checkpoint_transport.metadata(),
-            shrink_only=shrink_only,
-            timeout=quorum_timeout,
-            init_sync=self._init_sync,
-            commit_failures=self._commit_failures,
-        )
+        with obs_span("manager::quorum_rpc", step=self._step):
+            quorum = self._client._quorum(
+                group_rank=self._group_rank,
+                step=self._step,
+                checkpoint_metadata=self._checkpoint_transport.metadata(),
+                shrink_only=shrink_only,
+                timeout=quorum_timeout,
+                init_sync=self._init_sync,
+                commit_failures=self._commit_failures,
+            )
         timings["quorum_rpc_s"] = time.monotonic() - t0
         self._adopt_quorum(quorum, allow_heal, timings)
 
@@ -915,19 +980,32 @@ class Manager:
             )
             # fresh profiler epoch per quorum (flight-recorder analog)
             self._tracer.on_quorum_change(quorum_id)
+            # the (quorum_id, step) pair stamped here is the correlation
+            # anchor flight_merge aligns replicas' clocks on
+            self._flight.set_context(step=max_step, quorum_id=quorum_id)
+            self._flight.record(
+                FlightEvent.QUORUM_ADOPT,
+                step=max_step,
+                quorum_id=quorum_id,
+                world=replica_world_size,
+                replica_rank=replica_rank,
+            )
             t_cfg = time.monotonic()
             try:
                 self._quorum_id = quorum_id
-                self._comm.configure(
-                    store_prefixed_addr,
-                    self._replica_id if self._replica_id is not None else "0",
-                    replica_rank,
-                    replica_world_size,
-                    quorum_id=quorum_id,
-                    group_rank=self._group_rank,
-                    group_world_size=self._group_world_size,
-                    global_ranks=ranks_in_quorum,
-                )
+                with obs_span(
+                    "manager::comm_configure", quorum_id=quorum_id
+                ):
+                    self._comm.configure(
+                        store_prefixed_addr,
+                        self._replica_id if self._replica_id is not None else "0",
+                        replica_rank,
+                        replica_world_size,
+                        quorum_id=quorum_id,
+                        group_rank=self._group_rank,
+                        group_world_size=self._group_world_size,
+                        global_ranks=ranks_in_quorum,
+                    )
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in comm configure: {e}")
                 self.report_error(e)
@@ -978,31 +1056,49 @@ class Manager:
                 if send_dsts:
                     self._logger.info(f"peers need recovery from us {send_dsts}")
                     t_send = time.monotonic()
-                    if i_am_striped_source:
-                        self._checkpoint_transport.send_checkpoint_striped(
-                            dst_ranks=send_dsts,
-                            step=max_step,
-                            state_dict=self._manager_state_dict(),
-                            timeout=self._timeout,
-                            source_index=striped_sources.index(replica_rank),
-                            num_sources=len(striped_sources),
-                        )
-                    else:
-                        self._checkpoint_transport.send_checkpoint(
-                            dst_ranks=send_dsts,
-                            step=max_step,
-                            state_dict=self._manager_state_dict(),
-                            timeout=self._timeout,
-                        )
+                    self._flight.record(
+                        FlightEvent.HEAL_SEND_BEGIN,
+                        step=max_step,
+                        dst_ranks=list(send_dsts),
+                        striped=i_am_striped_source,
+                    )
+                    with obs_span("manager::heal_send", step=max_step):
+                        if i_am_striped_source:
+                            self._checkpoint_transport.send_checkpoint_striped(
+                                dst_ranks=send_dsts,
+                                step=max_step,
+                                state_dict=self._manager_state_dict(),
+                                timeout=self._timeout,
+                                source_index=striped_sources.index(replica_rank),
+                                num_sources=len(striped_sources),
+                            )
+                        else:
+                            self._checkpoint_transport.send_checkpoint(
+                                dst_ranks=send_dsts,
+                                step=max_step,
+                                state_dict=self._manager_state_dict(),
+                                timeout=self._timeout,
+                            )
                     timings["heal_send_s"] = time.monotonic() - t_send
+                    self._flight.record(
+                        FlightEvent.HEAL_SEND_END,
+                        step=max_step,
+                        duration_s=round(timings["heal_send_s"], 4),
+                    )
 
                 if heal:
                     t_recv = time.monotonic()
                     self._healing = True
+                    self._flight.record(
+                        FlightEvent.HEAL_RECV_BEGIN,
+                        step=max_step,
+                        sources=len(striped_sources) or 1,
+                    )
                     if len(striped_sources) > 1:
-                        self._pending_state_dict = self._recv_striped_checkpoint(
-                            quorum.heal_sources(), max_step, timings
-                        )
+                        with obs_span("manager::heal_recv", step=max_step):
+                            self._pending_state_dict = self._recv_striped_checkpoint(
+                                quorum.heal_sources(), max_step, timings
+                            )
                     else:
                         self._logger.info(
                             "healing required, fetching checkpoint metadata from "
@@ -1037,6 +1133,12 @@ class Manager:
                     )
                     self._step = max_step
                     timings["heal_recv_s"] = time.monotonic() - t_recv
+                    self._flight.set_context(step=max_step)
+                    self._flight.record(
+                        FlightEvent.HEAL_RECV_END,
+                        step=max_step,
+                        duration_s=round(timings["heal_recv_s"], 4),
+                    )
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in recovery: {e}")
                 self.report_error(e)
@@ -1110,6 +1212,7 @@ class Manager:
             for key, load_fn in self._load_state_dict_fns.items():
                 load_fn(pending_user[key])
             self._pending_state_dict = None
+        self._flight.record(FlightEvent.HEAL_APPLY, step=self._step)
         self._logger.info("Loaded state dict.")
 
     # ------------------------------------------------------------------
@@ -1415,10 +1518,12 @@ class Manager:
         except Exception as e:  # noqa: BLE001 — funnel, never raise
             self.report_error(e)
         # fence all in-flight collectives, then recovery, before voting
-        self._fence_pending_works()
-        if self._recovery_event is not None:
-            self._recovery_event.synchronize(timeout=self._timeout)
-            self._recovery_event = None
+        with obs_span("manager::fence", step=self._step):
+            self._fence_pending_works()
+            if self._recovery_event is not None:
+                self._recovery_event.synchronize(timeout=self._timeout)
+                self._recovery_event = None
+        self._flight.record(FlightEvent.COMMIT_FENCE, step=self._step)
 
         if (err := self._comm.errored()) is not None:
             self.report_error(err)
@@ -1440,11 +1545,20 @@ class Manager:
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None
-        should_commit = self._client.should_commit(
-            self._group_rank,
-            self._step,
-            local_should_commit,
-            timeout=timeout or self._timeout,
+        self._flight.record(
+            FlightEvent.COMMIT_VOTE, step=self._step, local=local_should_commit
+        )
+        with obs_span("manager::should_commit", step=self._step):
+            should_commit = self._client.should_commit(
+                self._group_rank,
+                self._step,
+                local_should_commit,
+                timeout=timeout or self._timeout,
+            )
+        self._flight.record(
+            FlightEvent.COMMIT_RESULT,
+            step=self._step,
+            committed=should_commit,
         )
         self._logger.info(
             f"should_commit={should_commit} enough_replicas={enough_replicas}, "
@@ -1528,6 +1642,13 @@ class Manager:
 
     def shutdown(self) -> None:
         self._tracer.stop()  # flush the final quorum epoch's trace
+        if flight_dir():
+            # the final complete ring (atexit's analog for in-process
+            # replicas — a thread-plane victim's dump survives its death)
+            try:
+                self._flight.dump("shutdown")
+            except OSError:
+                pass
         self._checkpoint_transport.shutdown(wait=False)
         if self._quorum_future is not None:
             try:

@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from torchft_tpu import knobs
 from torchft_tpu.lighthouse import LighthouseClient
+from torchft_tpu.obs import metrics as obs_metrics
 from torchft_tpu.wire import (
     ROLE_ACTIVE,
     ErrCode,
@@ -50,9 +51,11 @@ from torchft_tpu.wire import (
     configure_server_socket,
     create_listener,
     raise_if_error,
+    read_http_path,
     recv_frame,
     send_error,
     send_frame,
+    send_http_response,
 )
 
 logger = logging.getLogger(__name__)
@@ -237,6 +240,7 @@ class ManagerServer:
         warm_fn: Optional[Callable[[], Optional[object]]] = None,
         warm_step_fn: Optional[Callable[[], int]] = None,
         capacity_fn: Optional[Callable[[], float]] = None,
+        metrics_fn: Optional[Callable[[], Dict[str, float]]] = None,
     ) -> None:
         self._replica_id = replica_id
         self._lighthouse_addr = lighthouse_addr
@@ -272,6 +276,15 @@ class ManagerServer:
         # reacts at beat cadence.  Errors are swallowed like health_fn:
         # the probe must never kill the beat.
         self._capacity_fn = capacity_fn
+        # /metrics provider: extra per-replica gauges from the owning
+        # Manager (declared names only — obs/metrics.py enforces).  The
+        # endpoint rides the same listener via HTTP sniffing and serves a
+        # TTL-cached sample set (TORCHFT_METRICS_TTL_S), so a scrape storm
+        # re-polls the providers at most once per TTL.
+        self._metrics_fn = metrics_fn
+        self._metrics_cache: Tuple[float, bytes] = (float("-inf"), b"")
+        self._metrics_cache_lock = threading.Lock()
+        self.metrics_rebuilds = 0
         # hierarchical coordination plane: beats route through the zone
         # aggregator named by TORCHFT_AGG_ADDR (read live each beat) and
         # fall back to direct lighthouse beats on aggregator death.
@@ -536,6 +549,82 @@ class ManagerServer:
             "coord_agg_fallbacks": self._agg_fallbacks,
         }
 
+    # -- /metrics (Prometheus text; HTTP sniffed off the RPC port) ----------
+
+    def _metrics_text(self) -> bytes:
+        """TTL-cached Prometheus text: scrape storms rebuild (and re-poll
+        the Manager-side providers) at most once per
+        ``TORCHFT_METRICS_TTL_S``; concurrent scrapes serialize on the
+        cache lock, never on the quorum barrier."""
+        ttl = knobs.get_float("TORCHFT_METRICS_TTL_S", 0.5)
+        now = time.monotonic()
+        with self._metrics_cache_lock:
+            built_ts, raw = self._metrics_cache
+            if raw and now - built_ts < ttl:
+                return raw
+            raw = self._metrics_rebuild().encode()
+            self._metrics_cache = (now, raw)
+            return raw
+
+    def _metrics_rebuild(self) -> str:
+        # ftlint: ignore[thread-safety] — cache-lock-held rebuild counter
+        self.metrics_rebuilds += 1
+        sample = obs_metrics.metric_sample
+        samples = []
+        provided: Dict[str, float] = {}
+        if self._metrics_fn is not None:
+            try:
+                provided = self._metrics_fn() or {}
+            except Exception:  # noqa: BLE001 — probe must not kill a scrape
+                provided = {}
+        for name in sorted(provided):
+            samples.append(sample(name, provided[name]))
+        if "torchft_mgr_capacity" not in provided and self._capacity_fn:
+            samples.append(sample("torchft_mgr_capacity", self._capacity()))
+        health = None
+        if self._health_fn is not None:
+            try:
+                health = self._health_fn()
+            except Exception:  # noqa: BLE001 — probe must not kill a scrape
+                health = None
+        if health is not None:
+            samples += [
+                sample("torchft_mgr_comm_tx_bytes_total", health.tx_bytes),
+                sample("torchft_mgr_comm_rx_bytes_total", health.rx_bytes),
+                sample("torchft_mgr_comm_stalls_total", health.stalls),
+                sample("torchft_mgr_comm_reconnects_total", health.reconnects),
+                sample("torchft_mgr_comm_failovers_total", health.failovers),
+                sample("torchft_mgr_comm_faults_total", health.faults),
+            ]
+        coord = self.coord_stats()
+        samples += [
+            sample(
+                "torchft_mgr_beats_via_agg_total", coord["coord_beats_via_agg"]
+            ),
+            sample(
+                "torchft_mgr_beats_direct_total", coord["coord_beats_direct"]
+            ),
+            sample(
+                "torchft_mgr_agg_fallbacks_total", coord["coord_agg_fallbacks"]
+            ),
+        ]
+        return obs_metrics.render(samples)
+
+    def _handle_http(self, conn: socket.socket) -> None:
+        """Answer one HTTP request on the manager port: ``/metrics`` in
+        Prometheus text format (gated by ``TORCHFT_METRICS``)."""
+        path = read_http_path(conn)
+        if path is None:
+            return
+        if path == "/metrics" and knobs.get_bool("TORCHFT_METRICS", True):
+            body = self._metrics_text()
+            status = "200 OK"
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        else:
+            body = b"not found\n"
+            status, ctype = "404 Not Found", "text/plain"
+        send_http_response(conn, status, ctype, body)
+
     def _interrupt_lh_quorum(self) -> None:
         """Sever the persistent quorum-forwarding connection WITHOUT taking
         its rpc lock (the parked call holds it): the blocked recv errors
@@ -565,6 +654,23 @@ class ManagerServer:
 
     def _handle_conn(self, conn: socket.socket) -> None:
         try:
+            # sniff HTTP vs framed RPC on one port (lighthouse pattern) —
+            # but with NO idle deadline: a ManagerClient connects eagerly
+            # in Manager.__init__ and may not issue its first quorum RPC
+            # until after a minutes-long model build, and the pre-sniff
+            # server blocked in recv_frame indefinitely for exactly that
+            # reason.  The blocking MSG_PEEK preserves it; the inner loop
+            # only spins between bytes 1..4 of one frame header.
+            head = b""
+            while len(head) < 4:
+                head = conn.recv(4, socket.MSG_PEEK)
+                if not head:
+                    return  # peer closed before sending anything
+                if len(head) < 4:
+                    time.sleep(0.01)
+            if head[:3] in (b"GET", b"POS", b"HEA"):
+                self._handle_http(conn)
+                return
             while True:
                 msg_type, r = recv_frame(conn)
                 if msg_type == MsgType.MGR_QUORUM_REQ:

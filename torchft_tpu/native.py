@@ -34,6 +34,8 @@ from torchft_tpu.communicator import (
     ReduceOp,
 )
 from torchft_tpu.futures import TimerHandle, schedule_timeout
+from torchft_tpu.obs.flight import FlightEvent, FlightRecorder
+from torchft_tpu.obs.spans import span as obs_span
 from torchft_tpu.work import Work
 
 logger = logging.getLogger(__name__)
@@ -228,6 +230,16 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p,
             ctypes.c_void_p,
             ctypes.c_uint64,
+            ctypes.c_uint64,
+        ]
+        lib.tpuft_comm_flight_drain.restype = ctypes.c_uint64
+        lib.tpuft_comm_flight_drain.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
             ctypes.c_uint64,
         ]
         lib.tpuft_comm_barrier.argtypes = [ctypes.c_void_p]
@@ -487,6 +499,7 @@ class CppManagerServer:
         warm_fn: Optional[object] = None,
         warm_step_fn: Optional[object] = None,
         capacity_fn: Optional[object] = None,
+        metrics_fn: Optional[object] = None,
     ) -> None:
         import socket
 
@@ -503,7 +516,9 @@ class CppManagerServer:
         # replica needs the Python control plane (Manager refuses to
         # complete a re-lower on a native server_cls; docs/operations.md
         # §16 has the fallback matrix entry).
-        del health_fn, warm_fn, warm_step_fn, capacity_fn
+        # metrics_fn (/metrics gauges) likewise: the C++ sidecar serves no
+        # HTTP endpoint — scrape the lighthouse for fleet-level facts.
+        del health_fn, warm_fn, warm_step_fn, capacity_fn, metrics_fn
         if role != 0:
             raise ValueError(
                 "CppManagerServer does not support the SPARE role; use the "
@@ -574,6 +589,11 @@ class CppCommunicator(Communicator):
         # TCPCommunicator._inflight_ops)
         self._inflight_ops = 0
         self._inflight_lock = threading.Lock()
+        # flight recorder attachment point (set by the owning Manager):
+        # epoch lifecycle records Python-side, and the C-side fixed-slot
+        # ring drains into every dump via tpuft_comm_flight_drain
+        self.flight: Optional[FlightRecorder] = None
+        self._flight_registered = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -617,6 +637,20 @@ class CppCommunicator(Communicator):
                 daemon=True,
             )
             self._op_thread.start()
+        if self.flight is not None:
+            self.flight.set_comm_epoch(epoch)
+            self.flight.record(
+                FlightEvent.COMM_CONFIGURE,
+                comm_epoch=epoch,
+                quorum_id=quorum_id,
+                rank=rank,
+                world=world_size,
+                tier="cpp",
+            )
+            if not self._flight_registered:
+                # the C ring drains into every dump from here on
+                self.flight.register_native_source(self)
+                self._flight_registered = True
         logger.info(
             "cpp communicator configured: replica_id=%s rank=%d/%d quorum_id=%d",
             replica_id,
@@ -646,21 +680,38 @@ class CppCommunicator(Communicator):
 
     def abort(self, reason: str = "aborted") -> None:
         with self._lock:
+            newly_poisoned = self._errored is None
             if self._errored is None:
                 self._errored = CommunicatorAborted(reason)
             self._teardown_locked(reason)
             self._epoch += 1
+        self._flight_poison(reason, newly_poisoned)
         logger.warning("cpp communicator aborted: %s", reason)
+
+    def _flight_poison(self, reason: str, newly_poisoned: bool) -> None:
+        """Record the epoch teardown (+ poison/dump when an error actually
+        latched) — outside every lock, since a dump does file IO."""
+        flight = self.flight
+        if flight is None:
+            return
+        flight.record(FlightEvent.COMM_ABORT, reason=reason, tier="cpp")
+        if newly_poisoned and reason != "shutdown":
+            flight.record(
+                FlightEvent.COMM_POISON, reason=reason, tier="cpp"
+            )
+            flight.maybe_dump("comm_poison")
 
     def _abort_if_epoch(self, epoch: int, reason: str) -> None:
         def _do() -> None:
             with self._lock:
                 if self._epoch != epoch:
                     return
+                newly_poisoned = self._errored is None
                 if self._errored is None:
                     self._errored = CommunicatorAborted(reason)
                 self._teardown_locked(reason)
                 self._epoch += 1
+            self._flight_poison(reason, newly_poisoned)
             logger.warning("cpp communicator aborted: %s", reason)
 
         threading.Thread(target=_do, name="tpuft_cppcomm_abort", daemon=True).start()
@@ -751,6 +802,44 @@ class CppCommunicator(Communicator):
             "dead_lanes": 0,
         }
 
+    def flight_drain(self) -> List[Dict[str, object]]:
+        """Consume the C-side flight ring (``tpuft_comm_flight_drain``)
+        into event dicts shaped like the Python recorder's, marked
+        ``native``; repeated drains never duplicate events."""
+        with self._lock:
+            if self._h is None:
+                return []
+            cap = 256  # mirror of comm.h kFlightRingSlots
+            seqs = (ctypes.c_uint64 * cap)()
+            ts = (ctypes.c_double * cap)()
+            evs = (ctypes.c_uint32 * cap)()
+            a = (ctypes.c_int64 * cap)()
+            b = (ctypes.c_int64 * cap)()
+            n = int(
+                self._lib.tpuft_comm_flight_drain(
+                    self._h, seqs, ts, evs, a, b, cap
+                )
+            )
+        out: List[Dict[str, object]] = []
+        for i in range(n):
+            ev = int(evs[i])
+            out.append(
+                {
+                    "seq": int(seqs[i]),
+                    "t": round(float(ts[i]), 6),
+                    "ev": ev,
+                    "name": (
+                        FlightEvent(ev).name
+                        if ev in FlightEvent._value2member_map_
+                        else f"EV_{ev}"
+                    ),
+                    "a": int(a[i]),
+                    "b": int(b[i]),
+                    "native": True,
+                }
+            )
+        return out
+
     # -- op machinery ------------------------------------------------------
 
     def _run_ops(self, ops: "queue.Queue", epoch: int) -> None:
@@ -770,13 +859,18 @@ class CppCommunicator(Communicator):
             )
             self._op_started()
             try:
-                result = fn()
+                with obs_span("comm::op", epoch=epoch, tier="cpp"):
+                    result = fn()
             except BaseException as e:  # noqa: BLE001
+                latched = False
                 with self._lock:
                     if self._epoch == epoch and self._errored is None:
                         self._errored = (
                             e if isinstance(e, Exception) else RuntimeError(str(e))
                         )
+                        latched = True
+                if latched:
+                    self._flight_poison(str(e), True)
                 fut.set_exception(e)
             else:
                 fut.set_result(result)

@@ -41,6 +41,9 @@ STRUCTURED_LOGGERS = (
     "torchft_commits",
     "torchft_errors",
     "torchft_heals",
+    # flight-recorder dump announcements (obs/flight.py): one record per
+    # dump with the trigger reason, event counts and the artifact path
+    "torchft_flight",
 )
 
 _ATTR_KEYS = (
@@ -89,6 +92,11 @@ _ATTR_KEYS = (
     "heal_failed_sources",
     "heal_stolen_chunks",
     "heal_per_source_bytes",
+    # flight-recorder dump facts (torchft_flight; obs/flight.py dump())
+    "flight_reason",
+    "flight_events",
+    "flight_native_events",
+    "flight_path",
 )
 
 _initialized = False

@@ -406,6 +406,13 @@ class SpareAgent:
         self._shadow_fresh = self._delta_apply is not None
         # deltas at or before the snapshot step are already baked in
         self._delta_cursor = (self.warm_step, 1 << 60)
+        from torchft_tpu.obs.flight import FlightEvent
+
+        m._flight.record(
+            FlightEvent.SPARE_WARM,
+            step=self.warm_step,
+            lag=max(0, self._max_step - max(0, self.warm_step)),
+        )
         logger.info("spare warm snapshot loaded at step %d", self.warm_step)
 
     def _export_metrics(self) -> None:

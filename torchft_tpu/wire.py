@@ -1040,6 +1040,38 @@ def raise_if_error(msg_type: int, r: Reader) -> None:
     raise WireError(code, msg)
 
 
+def read_http_path(sock: socket.socket, timeout: float = 5.0) -> Optional[str]:
+    """Read one HTTP request head off ``sock`` and return its path (None
+    when the peer closes before a full head arrives).  Shared by the
+    lighthouse dashboard and the ManagerServer /metrics endpoint — both
+    sniff HTTP off their framed-RPC ports."""
+    sock.settimeout(timeout)
+    data = b""
+    while b"\r\n\r\n" not in data:
+        chunk = sock.recv(4096)
+        if not chunk:
+            return None
+        data += chunk
+    request_line = data.split(b"\r\n", 1)[0].decode("latin-1")
+    parts = request_line.split()
+    return parts[1] if len(parts) >= 2 else "/"
+
+
+def send_http_response(
+    sock: socket.socket, status: str, ctype: str, body: bytes
+) -> None:
+    """One complete connection-close HTTP response (best-effort: a dead
+    client must not raise into the serving loop)."""
+    resp = (
+        f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+        f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+    ).encode() + body
+    try:
+        sock.sendall(resp)
+    except OSError:
+        pass
+
+
 def create_listener(bind: str, backlog: int = 512) -> socket.socket:
     """Bound+listening server socket from a ``host:port`` string, dual-stack
     where possible (the reference binds ``[::]`` with v6only off so one
