@@ -1908,6 +1908,11 @@ def main() -> None:
         # PR-5 trajectory: outer sync cost, sharded vs replicated
         "sync_overhead_s_sharded": diloco.get("sync_overhead_s_sharded"),
         "sync_overhead_s_replicated": diloco.get("sync_overhead_s_replicated"),
+        # ISSUE-15 streamed outer sync: residual barrier cost, overlap
+        # win, and the fraction-of-an-inner-step headline (§18 gate 0.05)
+        "sync_overhead_s_streaming": diloco.get("sync_overhead_s_streaming"),
+        "stream_overlap_ratio": diloco.get("stream_overlap_ratio"),
+        "sync_overhead_frac": diloco.get("sync_overhead_frac"),
         # ISSUE-12 coordination plane: quorum latency through churn at
         # scale, lighthouse CPU, and the aggregation RPC win
         "coord_p99_quorum_latency_s": coord.get("p99_quorum_latency_s"),
@@ -2104,6 +2109,50 @@ def _run_diloco_phase(
             file=sys.stderr,
         )
         _emit_partial(diloco_faultfree_replicated=ff_by_wire["replicated"])
+    # ISSUE-15 streamed outer sync (docs/operations.md §18): one more leg
+    # on the chosen wire with the fragment scheduler forced on, so the
+    # artifact carries blocking-vs-streamed residual sync cost round over
+    # round.  Budget-guarded like the other A/B rows — churn is never
+    # starved for it — and TPUFT_BENCH_SKIP_STREAM=1 opts out.
+    budget_left = None if deadline_ts is None else deadline_ts - time.time()
+    per_frag = max(
+        1, sizes["diloco_sync_every"] // max(1, sizes["diloco_fragments"])
+    )
+    stall_room = per_frag - sizes["diloco_sync_delay"] - 1
+    if (
+        not os.environ.get("TPUFT_BENCH_SKIP_STREAM")
+        and stall_room >= 1
+        and (budget_left is None or budget_left >= 360.0)
+    ):
+        ff_by_wire["streaming"] = run_fleet(
+            "diloco_faultfree_streaming",
+            target_steps=ff_target,
+            sizes=sizes,
+            worker_platform=worker_platform,
+            replicas=replicas,
+            mode="diloco",
+            extra_env={
+                "TPUFT_BENCH_DILOCO_QUANT_WIRE": "1" if use_quant else "0",
+                "TORCHFT_STREAM_SYNC": "1",
+                "TORCHFT_STREAM_MAX_STALENESS": str(stall_room),
+            },
+            deadline_s=_budget_left(deadline_ts, 0.25, 90.0),
+        )
+        print(
+            f"bench: diloco fault-free [streaming] "
+            f"{ff_by_wire['streaming']}",
+            file=sys.stderr,
+        )
+        # the BENCH_r05 lesson: stream the leg into the partial artifact
+        # the moment it lands, never only into the final assembly
+        _emit_partial(diloco_faultfree_streaming=ff_by_wire["streaming"])
+    elif not os.environ.get("TPUFT_BENCH_SKIP_STREAM") and stall_room < 1:
+        print(
+            "bench: diloco streaming leg skipped — cadence has no "
+            f"staleness room (per_frag={per_frag}, "
+            f"delay={sizes['diloco_sync_delay']})",
+            file=sys.stderr,
+        )
     return _diloco_churn_and_summary(
         sizes, worker_platform, replicas, deadline_ts,
         ff_by_wire, faultfree, use_quant, gate, gate_reason,
@@ -2182,6 +2231,24 @@ def _diloco_churn_and_summary(
         out["sharded_vs_replicated_sync_overhead"] = round(
             so_r / max(so_s, 1e-4), 3
         )
+    # ISSUE-15 streamed outer sync: the residual barrier cost, how much of
+    # the blocking sync it hid, and the headline fraction of an inner step
+    # the residual represents (the §18 gate is <= 0.05 under wan_1g)
+    stream_leg = ff_by_wire.get("streaming")
+    so_stream = out.get("sync_overhead_s_streaming")
+    if so_stream is not None:
+        blocking = so_s if so_s is not None else so_r
+        if blocking is not None and blocking > 1e-4:
+            out["stream_overlap_ratio"] = round(
+                min(1.0, max(0.0, 1.0 - so_stream / blocking)), 3
+            )
+        inner_s = stream_leg.get("inner_step_s") or stream_leg.get(
+            "t_step_s"
+        )
+        if inner_s:
+            out["sync_overhead_frac"] = round(
+                so_stream / max(float(inner_s), 1e-6), 4
+            )
     if "sync_overhead_s_f32" in out and "sync_overhead_s_quant" in out:
         base = max(out["sync_overhead_s_f32"], 1e-4)
         out["quant_vs_f32_sync_overhead"] = round(

@@ -334,6 +334,103 @@ def _diloco_rank_main(rank, world, port, mb, iters, gbps, rtt_ms, out_q):
             f"{np.max(np.abs(params[f'replicated_{wire}'] - params[f'sharded_{wire}']))}"
         )
 
+    # ISSUE-15 streamed outer sync (docs/operations.md §18): the same
+    # sharded pipeline submitted on a background thread inside an
+    # inner-compute window (GIL-releasing numpy work, sized ~1.2x the
+    # measured blocking sync like the stall window a real streamed
+    # schedule grants), framed in the rotating STREAM_OUTER tag window.
+    # Measures the residual barrier wait — the §18 claim is that the wire
+    # drains under the window and the residual is ~0 — and hard-asserts
+    # the two ISSUE-15 gates: streamed-vs-blocking allclose, and
+    # cross-replica bit-identity of the streamed result.
+    import hashlib
+    import threading
+
+    from torchft_tpu import wire as wire_mod
+
+    stream_tag_base, stream_tag_span = wire_mod.stream_frag_tag_window(0)
+
+    def _streamed(quant: bool, window_s: float):
+        per = per_q if quant else per_f
+        state = shard_state[quant]
+        base = comm.rank() * per
+
+        def _cb(lo, hi, avg):
+            updates, _ = tx.update(
+                avg, _slice_state(state, per, lo - base, hi - base),
+                backup_pad[lo:hi],
+            )
+            return np.asarray(updates, dtype=np.float32)
+
+        box = {}
+
+        def _bg():
+            try:
+                box["delta"] = outer_sharded_sync(
+                    comm, psg, _cb, num_participants=world,
+                    should_quantize=quant,
+                    tag_base=stream_tag_base, tag_span=stream_tag_span,
+                )
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["err"] = e
+
+        th = threading.Thread(target=_bg, daemon=True)
+        t0 = time.perf_counter()
+        th.start()
+        m = np.ones((256, 256), dtype=np.float32)
+        while time.perf_counter() - t0 < window_s:
+            # inner compute: releases the GIL; 1/256 keeps the uniform
+            # matrix a fixed point instead of overflowing to inf
+            m = m @ m * (1.0 / 256.0)
+        wait0 = time.perf_counter()
+        th.join()
+        residual = time.perf_counter() - wait0
+        if "err" in box:
+            raise box["err"]
+        return backup + box["delta"], residual
+
+    for quant, wire in ((False, "f32"), (True, "quant")):
+        sync_s = results[f"diloco_sharded_{wire}_s"]
+        window_s = 1.2 * sync_s
+        p_stream, _ = _streamed(quant, window_s)  # warm
+        comm.barrier().wait(timeout=300.0)
+        residuals = []
+        for _ in range(3):
+            p_stream, resid = _streamed(quant, window_s)
+            residuals.append(resid)
+        comm.barrier().wait(timeout=300.0)
+        residual = sorted(residuals)[len(residuals) // 2]
+        results[f"diloco_streamed_{wire}_residual_s"] = residual
+        results[f"diloco_stream_overlap_{wire}"] = max(
+            0.0, min(1.0, 1.0 - residual / max(sync_s, 1e-9))
+        )
+        # gate 1 — streamed vs blocking: same pseudo-gradient, same shard
+        # state, same wire format, so the delta must match the blocking
+        # sharded leg to reduction-order noise (it is byte-identical in
+        # practice; the allclose bound is the ISSUE-15 acceptance wording)
+        assert np.allclose(
+            p_stream, params[f"sharded_{wire}"], rtol=0.0, atol=1e-6
+        ), (
+            f"streamed outer sync diverged from blocking ({wire}): max "
+            f"abs diff "
+            f"{np.max(np.abs(p_stream - params[f'sharded_{wire}']))}"
+        )
+        # gate 2 — cross-replica bit-identity: every rank applied the
+        # identical wire-format delta; compare sha256 digests through the
+        # (quiet) stream tag window rather than shipping params again
+        digest = np.frombuffer(
+            hashlib.sha256(np.ascontiguousarray(p_stream).tobytes()).digest(),
+            dtype=np.uint8,
+        ).astype(np.float32)
+        all_digests = comm.allgather(digest, tag=stream_tag_base).wait(
+            timeout=300.0
+        )
+        for r_idx, other in enumerate(all_digests):
+            assert np.array_equal(digest, np.asarray(other)), (
+                f"streamed params diverged across replicas ({wire}): "
+                f"rank {comm.rank()} vs rank {r_idx}"
+            )
+
     comm.barrier().wait(timeout=60.0)
     comm.shutdown()
     if rank == 0:
@@ -375,6 +472,10 @@ def run_diloco_profile(name, gbps, rtt_ms, mb, iters, world=3):
     res["diloco_sharded_vs_replicated_quant"] = round(
         res["diloco_replicated_quant_s"] / res["diloco_sharded_quant_s"], 3
     )
+    # ISSUE-15 headline: fraction of the blocking sync the streamed
+    # schedule hid under the inner-compute window (default wire)
+    if "diloco_stream_overlap_f32" in res:
+        res["diloco_stream_overlap"] = res["diloco_stream_overlap_f32"]
     return {k: (round(v, 4) if isinstance(v, float) else v) for k, v in res.items()}
 
 

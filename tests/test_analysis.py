@@ -963,6 +963,81 @@ class TestWireProtocol:
             == []
         )
 
+    # -- ISSUE-15 STREAM_OUTER rotating fragment windows --------------------
+
+    def test_stream_window_overlapping_legacy_flagged(self):
+        """Seeded-bad twin: a STREAM_OUTER span stretched into QUANT_RING
+        territory must read as a collision — the whole point of the
+        registry is that a streamed fragment sync can never alias the
+        quantized ring's frames."""
+        import torchft_tpu.wire as wire_mod
+
+        bad = dict(wire_mod.USER_TAG_ALLOCATIONS)
+        base = wire_mod.STREAM_OUTER_TAG_BASE
+        bad["STREAM_OUTER"] = (base, wire_mod.QUANT_RING_TAG - base + 1)
+        findings = wireproto.check_allocations(bad, wire_mod.WIRE_TAG_OFFSETS)
+        assert any(
+            "STREAM_OUTER" in f.symbol and "collide" in f.message
+            for f in findings
+        ), [f.render() for f in findings]
+
+    def test_stream_windows_partition_declared_span(self):
+        """Good twin: the rotating per-fragment windows tile exactly the
+        registered STREAM_OUTER allocation — disjoint, in-span, and each
+        wide enough for the collectives pipeline's 2-tags-per-chunk
+        framing."""
+        import torchft_tpu.wire as wire_mod
+
+        windows = [
+            wire_mod.stream_frag_tag_window(f)
+            for f in range(wire_mod.STREAM_FRAG_WINDOWS)
+        ]
+        lo = wire_mod.STREAM_OUTER_TAG_BASE
+        hi = lo + wire_mod.STREAM_OUTER_TAG_SPAN
+        covered = set()
+        for base, span in windows:
+            assert lo <= base and base + span <= hi
+            assert span >= 2  # at least one 2-tag pipeline chunk
+            rng = set(range(base, base + span))
+            assert not (rng & covered), "fragment windows overlap"
+            covered |= rng
+        assert covered == set(range(lo, hi)), (
+            "windows must tile the declared span exactly"
+        )
+        # and the rotation is total: any fragment index lands in-span
+        for frag in (wire_mod.STREAM_FRAG_WINDOWS, 7, 123):
+            base, span = wire_mod.stream_frag_tag_window(frag)
+            assert lo <= base and base + span <= hi
+
+    def test_unregistered_stream_range_literal_flagged(self):
+        """Seeded-bad twin: a hand-written literal inside the STREAM_OUTER
+        window must be flagged when the registry lacks STREAM_OUTER — the
+        named helper, not arithmetic on magic numbers, is the sanctioned
+        way into the window.  The whole allocation must sit ABOVE the
+        ad-hoc literal ceiling, or a lint-legal small literal could alias
+        window 0's frames unflagged."""
+        import torchft_tpu.wire as wire_mod
+
+        assert wire_mod.STREAM_OUTER_TAG_BASE > wireproto._ADHOC_TAG_MAX, (
+            "STREAM_OUTER overlaps the ad-hoc tag range: literals there "
+            "pass ftlint and would alias streamed frames"
+        )
+        base0 = wire_mod.stream_frag_tag_window(0)[0]
+        src = f"def f(comm):\n    comm.alltoall(parts, tag={base0})\n"
+        findings = wireproto.check_tag_literals(src, "fixture.py", {})
+        assert len(findings) == 1 and str(base0) in findings[0].message
+
+    def test_stream_helper_call_sites_pass(self):
+        """Good twin: the real collectives idiom — tag math over a value
+        returned by the helper, no literals — stays quiet."""
+        src = (
+            "from torchft_tpu import wire\n"
+            "def f(group, ci, frag):\n"
+            "    tag_base, _span = wire.stream_frag_tag_window(frag)\n"
+            "    group.alltoall(parts, tag=tag_base + 2 * ci)\n"
+        )
+        assert wireproto.check_tag_literals(src, "fixture.py", {}) == []
+
 
 # ---------------------------------------------------------------------------
 # knob-registry

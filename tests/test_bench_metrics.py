@@ -320,12 +320,100 @@ class TestDilocoQuantGate:
         assert labels == [
             "diloco_faultfree_quant",
             "diloco_faultfree_replicated",
+            "diloco_faultfree_streaming",
             "diloco_churn",
         ]
         assert out["quantized_sync"] is True
         assert out["quant_gate"] == "forced"
         repl_env = [e for (l, e) in calls if l == "diloco_faultfree_replicated"][0]
         assert repl_env["TORCHFT_OUTER_SHARD"] == "0"
+
+
+class TestDilocoStreamingLeg:
+    """The ISSUE-15 streamed outer-sync bench leg: runs on the chosen
+    wire with the fragment scheduler forced on, streams into the partial
+    artifact, and yields the stream_overlap_ratio / sync_overhead_frac
+    summary rows; TPUFT_BENCH_SKIP_STREAM opts out and a no-staleness-room
+    cadence skips it without failing the phase."""
+
+    def _run(self, monkeypatch, overheads, sizes_over=None, env=None):
+        calls = []
+
+        def fake_run_fleet(label, **kw):
+            calls.append((label, kw.get("extra_env", {})))
+            r = {"label": label, "kills": kw.get("max_kills") or 0,
+                 "t_step_s": 1.0, "completed": True,
+                 "ratio_per_100step_kill": 0.99}
+            for wire, so in overheads.items():
+                if label.endswith(wire) and so is not None:
+                    r["sync_overhead_s"] = so
+            if label.endswith("streaming"):
+                r["inner_step_s"] = 0.5
+            return r
+
+        monkeypatch.setattr(bench, "run_fleet", fake_run_fleet)
+        monkeypatch.delenv("TPUFT_BENCH_DILOCO_QUANT", raising=False)
+        monkeypatch.delenv("TPUFT_BENCH_SKIP_STREAM", raising=False)
+        if env:
+            for k, v in env.items():
+                monkeypatch.setenv(k, v)
+        sizes = {
+            "diloco_steps": 48, "diloco_sync_every": 8,
+            "diloco_fragments": 2, "diloco_sync_delay": 2,
+            "diloco_kills": 3,
+        }
+        sizes.update(sizes_over or {})
+        out = bench._run_diloco_phase(sizes, "cpu", 3, deadline_ts=None)
+        return out, calls
+
+    def test_streaming_leg_runs_with_stream_env(self, monkeypatch):
+        out, calls = self._run(
+            monkeypatch, {"f32": 0.4, "quant": 0.2, "streaming": 0.01}
+        )
+        env = [e for (l, e) in calls if l == "diloco_faultfree_streaming"][0]
+        assert env["TORCHFT_STREAM_SYNC"] == "1"
+        # per_frag = 8/2 = 4, delay 2 -> staleness room 1
+        assert env["TORCHFT_STREAM_MAX_STALENESS"] == "1"
+        # rides the measured-cheaper wire, like churn
+        assert env["TPUFT_BENCH_DILOCO_QUANT_WIRE"] == "1"
+        assert out["sync_overhead_s_streaming"] == 0.01
+        # overlap vs the sharded (blocking) leg: 1 - 0.01/0.2
+        assert out["stream_overlap_ratio"] == 0.95
+        # residual over the streaming leg's inner step time: 0.01/0.5
+        assert out["sync_overhead_frac"] == 0.02
+
+    def test_skip_knob_opts_out(self, monkeypatch):
+        out, calls = self._run(
+            monkeypatch,
+            {"f32": 0.4, "quant": 0.2, "streaming": 0.01},
+            env={"TPUFT_BENCH_SKIP_STREAM": "1"},
+        )
+        labels = [l for (l, _e) in calls]
+        assert "diloco_faultfree_streaming" not in labels
+        assert "sync_overhead_s_streaming" not in out
+        assert "stream_overlap_ratio" not in out
+        assert "diloco_churn" in labels  # churn untouched
+
+    def test_no_staleness_room_skips_leg(self, monkeypatch):
+        # per_frag = 4, delay 3 -> room 0: the leg cannot stream
+        out, calls = self._run(
+            monkeypatch,
+            {"f32": 0.4, "quant": 0.2, "streaming": 0.01},
+            sizes_over={"diloco_sync_delay": 3},
+        )
+        labels = [l for (l, _e) in calls]
+        assert "diloco_faultfree_streaming" not in labels
+        assert "diloco_churn" in labels
+
+    def test_missing_blocking_overhead_still_reports_frac(self, monkeypatch):
+        """A pinned-legacy or overhead-less run must not lose the
+        streaming residual: the frac lands even when the ratio cannot."""
+        out, _calls = self._run(
+            monkeypatch, {"f32": None, "quant": None, "streaming": 0.01}
+        )
+        assert out["sync_overhead_s_streaming"] == 0.01
+        assert "stream_overlap_ratio" not in out
+        assert out["sync_overhead_frac"] == 0.02
 
 
 class TestPhaseACaptureGuards:

@@ -448,10 +448,13 @@ _MAX_OUTER_CHUNKS = 64
 _OUTER_TAG_BASE = OUTER_SHARD_TAG_BASE
 
 
-def _outer_chunk_ranges(per: int, unit: int, gsize: int) -> List[Tuple[int, int]]:
+def _outer_chunk_ranges(
+    per: int, unit: int, gsize: int, max_chunks: int = _MAX_OUTER_CHUNKS
+) -> List[Tuple[int, int]]:
     """Pipeline chunk ranges WITHIN one shard's [0, per) element extent,
     unit-aligned so quantization rows never split; identical on every
-    replica (pure function of the layout)."""
+    replica (pure function of the layout).  ``max_chunks`` bounds the
+    pipeline depth to the caller's tag window (2 tags per chunk)."""
     try:
         mb = float(
             os.environ.get(OUTER_CHUNK_MB_ENV, "") or DEFAULT_OUTER_CHUNK_MB
@@ -461,7 +464,7 @@ def _outer_chunk_ranges(per: int, unit: int, gsize: int) -> List[Tuple[int, int]
     # per-shard slice of one chunk, in elements (f32), unit-aligned
     want = int(mb * (1 << 20)) // 4 // max(1, gsize)
     want = max(unit, want // unit * unit)
-    floor = -(-per // (_MAX_OUTER_CHUNKS * unit)) * unit  # cap chunk count
+    floor = -(-per // (max_chunks * unit)) * unit  # cap chunk count
     step = max(want, floor, unit)
     return [(c, min(c + step, per)) for c in range(0, per, step)]
 
@@ -496,6 +499,8 @@ def outer_sharded_sync(
     timings: Optional[dict] = None,
     tap: Optional[Callable[[np.ndarray], None]] = None,
     weight: Optional[float] = None,
+    tag_base: int = _OUTER_TAG_BASE,
+    tag_span: int = wire.OUTER_SHARD_TAG_SPAN,
 ) -> np.ndarray:
     """ZeRO-1-style sharded outer sync: chunk-pipelined
     ``reduce_scatter → sharded outer update → allgather(update)``.
@@ -538,6 +543,13 @@ def outer_sharded_sync(
     update) right before it is returned: the hot-spare delta feed rides
     this hook so parked observers can keep a shadow bit-exact without
     participating in the collective.  A tap failure never fails the sync.
+
+    ``tag_base`` / ``tag_span`` frame the chunk collectives: the default is
+    the legacy OUTER_SHARD window (byte-identical to the pre-stream path);
+    the streamed fragment scheduler passes a rotating per-fragment
+    STREAM_OUTER window (``wire.stream_frag_tag_window``) so consecutive
+    streamed syncs can never alias tags.  The pipeline depth is capped at
+    ``tag_span // 2`` chunks (2 tags per chunk).
 
     ``weight``, if given, turns the sync into a capacity-WEIGHTED sum
     (degraded-mode fleets): this replica's contribution is pre-scaled by
@@ -595,6 +607,8 @@ def outer_sharded_sync(
                     kind,
                     row_size,
                     tm,
+                    tag_base=tag_base,
+                    tag_span=tag_span,
                 )
         except BaseException as e:  # noqa: BLE001
             err = err or e
@@ -635,6 +649,8 @@ def _outer_sharded_pipeline(
     kind: str,
     row_size: int,
     tm: dict,
+    tag_base: int = _OUTER_TAG_BASE,
+    tag_span: int = wire.OUTER_SHARD_TAG_SPAN,
 ) -> np.ndarray:
     """Shard-owner body of :func:`outer_sharded_sync` over ``group`` (the
     flat communicator, or the leader view on hierarchical topologies)."""
@@ -642,7 +658,7 @@ def _outer_sharded_pipeline(
     gidx = group.rank() if gsize > 1 else 0
     buf = np.zeros(padded, dtype=np.float32)
     buf[: contrib.size] = contrib
-    chunks = _outer_chunk_ranges(per, unit, gsize)
+    chunks = _outer_chunk_ranges(per, unit, gsize, max_chunks=tag_span // 2)
     inv = 1.0 / max(1, num_participants)
     delta_full = np.empty(padded, dtype=np.float32)
     err: Optional[BaseException] = None
@@ -692,7 +708,7 @@ def _outer_sharded_pipeline(
             ]
         else:
             parts = [buf[p * per + c0 : p * per + c1] for p in range(gsize)]
-        return group.alltoall(parts, tag=_OUTER_TAG_BASE + 2 * ci)
+        return group.alltoall(parts, tag=tag_base + 2 * ci)
 
     a2a_work = _submit_a2a(0)
     ag_works: List[Work] = []
@@ -737,11 +753,11 @@ def _outer_sharded_pipeline(
         if should_quantize:
             dq, ds = quantize_rowwise(delta, row_size, kind)
             ag_works.append(
-                group.allgather(_pack(dq, ds), tag=_OUTER_TAG_BASE + 2 * ci + 1)
+                group.allgather(_pack(dq, ds), tag=tag_base + 2 * ci + 1)
             )
         else:
             ag_works.append(
-                group.allgather(delta, tag=_OUTER_TAG_BASE + 2 * ci + 1)
+                group.allgather(delta, tag=tag_base + 2 * ci + 1)
             )
 
     for ci, work in enumerate(ag_works):

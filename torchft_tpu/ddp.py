@@ -15,7 +15,7 @@ import functools
 import os
 import threading
 from concurrent.futures import Future
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -142,26 +142,44 @@ def _host_contribution(leaf: Any) -> Tuple[np.ndarray, Any]:
     return flat, _restore_sharded
 
 
-def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False) -> Work:
+def allreduce_pytree(
+    manager: Manager,
+    tree: Any,
+    should_quantize: bool = False,
+    stream: Optional[int] = None,
+) -> Work:
     """Average a pytree of gradients across participating replicas.
 
     Returns a Work whose value is the averaged pytree with original leaf
     types restored (jax leaves come back as device arrays with their
     original sharding).  Error swallowing and participation zeroing happen
     inside ``manager.allreduce``.
+
+    ``stream``, when given, marks this as an ASYNC streamed fragment submit
+    (the TORCHFT_STREAM_SYNC LocalSGD scheduler): exactly one work — the
+    composite covering every bucket ring AND the restore — registers in the
+    Manager's stream-fence registry instead of ``_pending_works``, same
+    contract as ``Manager.outer_shard_allreduce(stream=)``; the per-bucket
+    works are owned by the composite and register nowhere.  Not supported
+    on the device-quantized path (no streamed caller quantizes here — the
+    quantized streamed wire is DiLoCo's, via ``Manager.allreduce(stream=)``).
     """
+
+    def _streamed(w: Work) -> Work:
+        return w if stream is None else manager.stream_submitted(stream, w)
+
     if manager.errored():
-        return allreduce_pytree_result(tree)
+        return _streamed(allreduce_pytree_result(tree))
     if manager.allreduce_is_identity():
         # single-member quorum: averaging is the identity; skip the
         # device→host→device round trip entirely
-        return allreduce_pytree_result(tree)
+        return _streamed(allreduce_pytree_result(tree))
 
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
-        return allreduce_pytree_result(tree)
+        return _streamed(allreduce_pytree_result(tree))
 
-    if should_quantize and all(
+    if stream is None and should_quantize and all(
         isinstance(l, jax.Array) and l.is_fully_addressable for l in leaves
     ):
         # (multi-host arrays fall through to the bucketed path, which ships
@@ -242,7 +260,10 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
             # much as half the ring itself)
             works.append(
                 manager.allreduce(
-                    flat, should_quantize=should_quantize, in_place=True
+                    flat,
+                    should_quantize=should_quantize,
+                    in_place=True,
+                    register_pending=stream is None,
                 )
             )
             bucket_layouts.append(layout)
@@ -270,8 +291,14 @@ def allreduce_pytree(manager: Manager, tree: Any, should_quantize: bool = False)
     out = Work(fut)
     # fence the WHOLE pipeline (including restore/device_put) at commit, not
     # just the wire collectives — a restore failure after the vote would
-    # otherwise apply unaveraged gradients on this replica only
-    manager._register_pending(out)
+    # otherwise apply unaveraged gradients on this replica only.  Streamed
+    # submits register the same composite in the stream-fence registry
+    # instead, where the vote REFUSES (rather than waits) while it's in
+    # flight.
+    if stream is None:
+        manager._register_pending(out)
+    else:
+        manager.stream_submitted(stream, out)
     return out
 
 

@@ -94,12 +94,28 @@ def gray_failure_drill(
       ``begin_relower`` and ``complete_relower``; the drill proves the
       half-relowered replica never voted commit and the survivors carry
       on.
+    - ``stream_kill_mid_fragment``: a streamed-DiLoCo fleet
+      (``TORCHFT_STREAM_SYNC=1``) loses one replica WHILE a fragment's
+      outer sync is streaming under inner compute; the drill proves the
+      half-streamed sync is FULLY discarded (survivors' barrier vote is
+      False, FRAG_SUBMIT→FRAG_ABORT on every survivor's own flight ring,
+      params reset to the pre-sync backup) and that after the replacement
+      heals in the fleet commits streamed syncs again with ZERO divergence
+      (final params bit-identical across all three).
 
     Returns summary facts (also asserted internally)."""
     from torchft_tpu.chaos import ChaosController, Failure, ThreadReplica
     from torchft_tpu.communicator import TCPCommunicator
     from torchft_tpu.lighthouse import LighthouseServer
     from torchft_tpu.manager import Manager
+
+    if mode == "stream_kill_mid_fragment":
+        return _stream_drill(
+            num_replicas=num_replicas,
+            steps=steps,
+            arm_at_step=arm_at_step,
+            timeout_s=timeout_s,
+        )
 
     if mode in (
         "device_loss",
@@ -709,6 +725,23 @@ def _device_loss_drill(
     promoted_ts: List[float] = [0.0]
     mid_commit: List[Optional[bool]] = [None]
     stop = threading.Event()
+    # The replicas' step budget, finalized by the MAIN thread only after
+    # the wound has verifiably landed.  A fixed budget of ``steps`` was the
+    # root cause of the long-standing "lighthouse never saw the wound"
+    # flake: the arming wait polls commits at 50 ms granularity while a
+    # loopback round takes ~10 ms, so on a fast machine the fleet could
+    # sprint from the arming step straight past the whole budget during
+    # one poll sleep — every replica loop exited on ``current_step() <
+    # steps`` before ``chaos.inject`` ran (or before the victim's next
+    # loop-top consumed the armed loss), no post-wound quorum ever issued,
+    # and the final status legitimately showed three full-capacity
+    # participants.  With an open-ended budget the loops keep stepping
+    # until the main thread has SEEN the relower (victim.wounded /
+    # capacity < 1) and pins the target far enough out that several
+    # post-wound rounds must commit.  (Reproduced deterministically by
+    # inserting a 0.5 s sleep before the inject: 3/3 failures with the
+    # exact flake signature, 0/15 after this fix.)
+    step_target: List[Optional[int]] = [None]
     warm_gate = threading.Event()
     promoted = threading.Event()
     if not with_spare:
@@ -809,7 +842,10 @@ def _device_loss_drill(
             self.manager.complete_relower(plan.capacity)
 
         def active_loop(self, stop: threading.Event) -> None:
-            while not stop.is_set() and self.manager.current_step() < steps:
+            while not stop.is_set() and (
+                step_target[0] is None
+                or self.manager.current_step() < step_target[0]
+            ):
                 if (
                     not warm_gate.is_set()
                     and self.manager.current_step() >= arm_at_step + 2
@@ -907,18 +943,38 @@ def _device_loss_drill(
             devices=1,
             mid_relower=mid_kill,
         )
+        # the wound must LAND before the step budget is pinned: the victim
+        # consumes the armed loss at its next loop-top, and (mid-kill
+        # aside) advertises its reduced capacity on the registration right
+        # after complete_relower — only then is "a post-wound quorum
+        # issues before the fleet stops" guaranteed
+        deadline = time.monotonic() + 60.0
+        while (
+            not victim.wounded or (not mid_kill and victim.capacity >= 1.0)
+        ) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert victim.wounded, "victim never consumed the armed device loss"
+        if not mid_kill:
+            assert victim.capacity < 1.0, "victim relower never completed"
+        # pin the budget: at least ``steps`` total, and at least a few
+        # rounds past the wound so the victim's capacity registration is
+        # carried by quorums the whole fleet commits
+        target = max(
+            steps, max(r.manager.current_step() for r in actives) + 3
+        )
+        step_target[0] = target
 
         if mode == "device_loss":
             deadline = time.monotonic() + 240.0
             while (
-                min(r.commits for r in actives) < steps
+                min(r.commits for r in actives) < target
                 and time.monotonic() < deadline
             ):
                 time.sleep(0.05)
             stop.set()
             for t in threads:
                 t.join(timeout=2 * timeout_s + 10.0)
-            assert all(r.commits >= steps for r in actives), (
+            assert all(r.commits >= target for r in actives), (
                 f"fleet stalled after device loss: "
                 f"{[r.commits for r in actives]}"
             )
@@ -968,7 +1024,7 @@ def _device_loss_drill(
             fleet = survivors + [spare]
             deadline = time.monotonic() + 240.0
             while (
-                min(r.manager.current_step() for r in fleet) < steps
+                min(r.manager.current_step() for r in fleet) < target
                 and time.monotonic() < deadline
             ):
                 time.sleep(0.05)
@@ -979,7 +1035,7 @@ def _device_loss_drill(
             for t in join_list:
                 t.join(timeout=2 * timeout_s + 10.0)
             assert all(
-                r.manager.current_step() >= steps for r in fleet
+                r.manager.current_step() >= target for r in fleet
             ), f"fleet stalled after swap: {[r.commits for r in fleet]}"
             status = lighthouse._status()
             assert status["swaps_total"] >= 1, status
@@ -1010,14 +1066,14 @@ def _device_loss_drill(
             # that one step in flight after faster survivors finish —
             # asserting then would read mid_commit before it exists
             while (
-                min(r.commits for r in survivors) < steps
+                min(r.commits for r in survivors) < target
                 or mid_commit[0] is None
             ) and time.monotonic() < deadline:
                 time.sleep(0.05)
             stop.set()
             for t in threads:
                 t.join(timeout=2 * timeout_s + 10.0)
-            assert all(r.commits >= steps for r in survivors), (
+            assert all(r.commits >= target for r in survivors), (
                 f"survivors stalled after mid-relower death: "
                 f"{[r.commits for r in survivors]}"
             )
@@ -1045,7 +1101,9 @@ def _device_loss_drill(
             # total samples — capacity-proportional shards partition the
             # same usable set, so the weighted average IS the global
             # average (up to largest-remainder rounding)
-            expected = -lr * steps * X.mean(axis=0)
+            # every replica committed exactly ``target`` rounds (the
+            # post-wound budget pinned above)
+            expected = -lr * target * X.mean(axis=0)
             np.testing.assert_allclose(
                 fleet[0].params, expected, rtol=2e-2, atol=2e-2
             )
@@ -1075,6 +1133,364 @@ def _device_loss_drill(
             else:
                 os.environ[k] = v
     return result
+
+
+def _stream_drill(
+    num_replicas: int = 3,
+    steps: int = 10,
+    arm_at_step: int = 2,
+    timeout_s: float = 20.0,
+    payload_elems: int = 150_000,
+) -> Dict[str, Any]:
+    """Streamed-DiLoCo chaos (``stream_kill_mid_fragment`` — see
+    :func:`gray_failure_drill` for the mode contract): kill one replica
+    WHILE a fragment's outer sync is streaming under inner compute, prove
+    the half-streamed sync is fully discarded, then heal a replacement in
+    and prove zero divergence.
+
+    ``steps`` counts COMMITTED outer syncs on the anchor.  The victim dies
+    microseconds after its streamed submit (the collectives — ~1.2 MB of
+    pseudo-gradient through the 3-way a2a/allgather — are still on the
+    wire), so the survivors' in-flight chunk exchanges poison, their
+    barrier vote comes back False, and ``FRAG_SUBMIT → FRAG_ABORT`` lands
+    on every survivor's own seq-ordered flight ring."""
+    import glob
+    import sys
+    import tempfile
+
+    import optax
+
+    from torchft_tpu.communicator import TCPCommunicator
+    from torchft_tpu.lighthouse import LighthouseServer
+    from torchft_tpu.local_sgd import DiLoCo
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.obs.flight import FlightEvent
+
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    )
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import flight_merge
+
+    assert num_replicas >= 3, "stream drills need a surviving majority"
+
+    tmp_ctx = tempfile.TemporaryDirectory(prefix="tpuft_stream_")
+    saved_env = {
+        k: os.environ.get(k)
+        for k in (
+            "TORCHFT_STREAM_SYNC",
+            "TORCHFT_STREAM_MAX_STALENESS",
+            "TORCHFT_FLIGHT_DIR",
+        )
+    }
+    # per-fragment cadence 2, delay 0 → staleness room 1: the sync step
+    # streams and the delta applies one inner step later
+    os.environ["TORCHFT_STREAM_SYNC"] = "1"
+    os.environ["TORCHFT_STREAM_MAX_STALENESS"] = "1"
+    os.environ["TORCHFT_FLIGHT_DIR"] = tmp_ctx.name
+    # per-fragment trace spans on for the drill: the submit/barrier span
+    # pair is part of the ISSUE-15 observability contract and asserted
+    # below next to the FRAG_* flight events
+    from torchft_tpu.obs import spans as obs_spans
+
+    obs_spans.configure(True)
+    obs_spans.clear()
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=num_replicas - 1,
+        join_timeout_ms=300,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=800,
+    )
+    stop = threading.Event()
+    killed_ts: List[float] = [0.0]
+    # committed-step bound every replica exits at, set once the drill's
+    # phases are done: loops leaving at the SAME outer round is what makes
+    # the final committed-state compare exact (an uncoordinated stop
+    # leaves a legitimate ±1-round skew between replicas)
+    final_target: List[Optional[int]] = [None]
+
+    class _Rep:
+        def __init__(self, idx: int, life: int = 0) -> None:
+            self.idx = idx
+            self.life = life
+            # two leaves → two fragments; ~600 KB each so a streamed sync
+            # is always mid-wire when the victim dies right after submit
+            self.holder: Dict[str, Any] = {
+                "params": {
+                    "a": np.full(payload_elems, 1.0, dtype=np.float32),
+                    "b": np.full(payload_elems, 2.0, dtype=np.float32),
+                }
+            }
+            self.healed = False
+            self.comm = TCPCommunicator(timeout_s=timeout_s)
+            self.manager = Manager(
+                comm=self.comm,
+                load_state_dict=self._load,
+                state_dict=lambda: dict(self.holder),
+                min_replica_size=num_replicas - 1,
+                use_async_quorum=False,
+                replica_id=f"stream_{idx}" + ("r" * life),
+                lighthouse_addr=lighthouse.local_address(),
+                timeout=timeout_s,
+                quorum_timeout=timeout_s,
+                connect_timeout=timeout_s,
+            )
+            self.diloco = DiLoCo(
+                self.manager,
+                self.holder,
+                optax.sgd(0.7, momentum=0.9, nesterov=True),
+                sync_every=4,
+                num_fragments=2,
+            )
+            assert self.diloco.streaming(), "drill requires streamed mode"
+            self.commits = 0
+            self.aborts = 0
+            self.kill_flag = threading.Event()
+
+        def _load(self, sd: Dict[str, Any]) -> None:
+            self.holder.update(sd)
+            self.healed = True
+
+        def loop(self) -> None:
+            while not stop.is_set() and (
+                final_target[0] is None
+                or self.manager.current_step() < final_target[0]
+            ):
+                # a token of "inner compute" per step: a real train loop
+                # spends real time here, and pacing the drill the same way
+                # keeps failed rounds from spinning so hot that the two
+                # survivors' 300 ms quorum-join windows never overlap
+                time.sleep(0.002)
+                self.holder["params"] = {
+                    k: v - 0.01 * (self.idx + 1)
+                    for k, v in self.holder["params"].items()
+                }
+                try:
+                    committed = self.diloco.step()
+                except Exception:  # noqa: BLE001 — a failed round, not a crash
+                    committed = False
+                if committed is True:
+                    self.commits += 1
+                elif committed is False:
+                    self.aborts += 1
+                    time.sleep(0.05)  # failed round: back off before retrying
+                if (
+                    self.kill_flag.is_set()
+                    and self.diloco._stream_pending_frag is not None
+                ):
+                    # die MID-FRAGMENT: the streamed submit just happened
+                    # and this thread still holds the GIL, so the submit's
+                    # background thread has not contributed a frame yet —
+                    # severing the comm NOW guarantees the peers' streamed
+                    # chunk exchanges die half-fed (a graceful shutdown
+                    # would let the ~1 ms loopback collective finish first
+                    # and the "mid-fragment" kill would prove nothing)
+                    killed_ts[0] = time.monotonic()
+                    try:
+                        self.comm.abort("stream drill kill")
+                    except Exception:  # noqa: BLE001 — dying anyway
+                        pass
+                    self.manager.shutdown()
+                    return
+
+    replicas = [_Rep(i) for i in range(num_replicas)]
+    victim = replicas[num_replicas - 1]
+    threads = [
+        threading.Thread(target=r.loop, daemon=True) for r in replicas
+    ]
+    report: Dict[str, Any] = {}
+    victim2: Optional[_Rep] = None
+    victim2_thread: Optional[threading.Thread] = None
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 120.0
+        while (
+            min(r.commits for r in replicas) < arm_at_step
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert min(r.commits for r in replicas) >= arm_at_step, (
+            "fleet never reached the arming step"
+        )
+        survivors = [r for r in replicas if r is not victim]
+        aborts_at_kill = [r.aborts for r in survivors]
+        victim.kill_flag.set()
+        deadline = time.monotonic() + 60.0
+        while not killed_ts[0] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert killed_ts[0], "victim never died mid-fragment"
+
+        # the half-streamed round must be DISCARDED on every survivor
+        deadline = time.monotonic() + 120.0
+        while (
+            any(
+                r.aborts <= a0
+                for r, a0 in zip(survivors, aborts_at_kill)
+            )
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert all(
+            r.aborts > a0 for r, a0 in zip(survivors, aborts_at_kill)
+        ), (
+            "survivors never discarded the half-streamed sync: "
+            f"aborts {[r.aborts for r in survivors]} (at kill "
+            f"{aborts_at_kill}), commits {[r.commits for r in survivors]}"
+        )
+
+        # replacement heals in and the fleet commits streamed syncs again
+        victim2 = _Rep(victim.idx, life=1)
+        victim2_thread = threading.Thread(target=victim2.loop, daemon=True)
+        victim2_thread.start()
+        deadline = time.monotonic() + 180.0
+        fleet = survivors + [victim2]
+        while (
+            not (
+                victim2.healed
+                and victim2.commits >= 2
+                and min(r.commits for r in fleet) >= steps
+            )
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert victim2.healed, "replacement never healed"
+        assert victim2.commits >= 2, (
+            f"replacement never committed with the fleet ({victim2.commits})"
+        )
+        assert all(r.commits >= steps for r in fleet), (
+            f"fleet stalled: {[r.commits for r in fleet]}"
+        )
+        # coordinated finish: every loop exits right after committing the
+        # same outer round, so the committed state lines up exactly
+        final_target[0] = (
+            max(r.manager.current_step() for r in fleet) + 2
+        )
+        deadline = time.monotonic() + 120.0
+        while (
+            min(r.manager.current_step() for r in fleet) < final_target[0]
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        stop.set()
+        for t in threads + [victim2_thread]:
+            t.join(timeout=2 * timeout_s + 10.0)
+
+        # ZERO divergence: the discarded sync left no trace — every
+        # surviving replica (the healed replacement included) holds
+        # bit-identical COMMITTED state (the per-fragment backups; live
+        # leaves legitimately differ by in-flight local inner progress)
+        for fi in range(2):
+            ref = fleet[0].diloco._fragments[fi].backup
+            for other in fleet[1:]:
+                theirs = other.diloco._fragments[fi].backup
+                for a, b in zip(ref, theirs):
+                    assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                        f"committed state diverged on fragment {fi} "
+                        f"({fleet[0].idx} vs {other.idx})"
+                    )
+
+        # flight evidence on the merged fleet timeline: every survivor's
+        # own seq-ordered ring carries the fragment lifecycle — a
+        # FRAG_SUBMIT → FRAG_ABORT pair for the killed round, and a later
+        # FRAG_SUBMIT → FRAG_COMMIT once the replacement healed in
+        for r in fleet:
+            r.manager._flight.dump("drill_end")
+        merged = flight_merge.merge_flight_dumps(
+            sorted(glob.glob(os.path.join(tmp_ctx.name, "flight_*.jsonl")))
+        )
+        events = merged["events"]
+        report["events_merged"] = len(events)
+        report["replicas_merged"] = len(merged["replicas"])
+        for r in survivors:
+            own = [
+                e
+                for e in events
+                if e.get("replica_id", "").startswith(f"stream_{r.idx}:")
+            ]
+            own.sort(key=lambda e: e.get("seq", 0))
+            types = [e.get("ev") for e in own]
+            assert int(FlightEvent.FRAG_SUBMIT) in types, (
+                f"survivor {r.idx}: no FRAG_SUBMIT recorded"
+            )
+            abort_at = _first_index(types, int(FlightEvent.FRAG_ABORT))
+            assert abort_at is not None, (
+                f"survivor {r.idx}: half-streamed sync never recorded "
+                "FRAG_ABORT"
+            )
+            submit_before = _first_index(
+                types[:abort_at], int(FlightEvent.FRAG_SUBMIT)
+            )
+            assert submit_before is not None, (
+                f"survivor {r.idx}: FRAG_ABORT without a prior FRAG_SUBMIT"
+            )
+            commit_after = _first_index(
+                types[abort_at:], int(FlightEvent.FRAG_COMMIT)
+            )
+            assert commit_after is not None, (
+                f"survivor {r.idx}: no streamed FRAG_COMMIT after the "
+                "abort — the fleet never resumed streaming"
+            )
+        # per-fragment trace spans: every streamed round records a
+        # stream::submit / stream::barrier pair tagged with its fragment
+        # index (both fragments of the two-leaf model must appear) — the
+        # span side of the same lifecycle the FRAG_* events pin above
+        span_frags: Dict[str, set] = {
+            "stream::submit": set(),
+            "stream::barrier": set(),
+        }
+        for rec in obs_spans.snapshot():
+            if rec["name"] in span_frags:
+                frag = (rec.get("attrs") or {}).get("frag")
+                if frag is not None:
+                    span_frags[rec["name"]].add(frag)
+        for name, frags in span_frags.items():
+            assert frags >= {0, 1}, (
+                f"{name} spans missing fragments: saw {sorted(frags)}, "
+                "need both fragments of the streamed model"
+            )
+        report["stream_span_frags"] = {
+            k: sorted(v) for k, v in span_frags.items()
+        }
+        report.update(
+            commits=[r.commits for r in fleet],
+            aborts=[r.aborts for r in survivors],
+            bit_identical=True,
+            healed=True,
+        )
+    finally:
+        obs_spans.configure(None)
+        obs_spans.clear()
+        stop.set()
+        victim.kill_flag.set()
+        join_list = threads + (
+            [victim2_thread] if victim2_thread is not None else []
+        )
+        for t in join_list:
+            t.join(timeout=5.0)
+        for r in replicas + ([victim2] if victim2 is not None else []):
+            try:
+                r.manager.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        lighthouse.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        tmp_ctx.cleanup()
+    return report
+
+
+def _first_index(seq: List[Any], value: Any) -> Optional[int]:
+    try:
+        return seq.index(value)
+    except ValueError:
+        return None
 
 
 def joint_ft_spmd_drill(

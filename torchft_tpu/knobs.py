@@ -175,6 +175,11 @@ _k("TORCHFT_OUTER_SHARD", "str", "auto",
    "ZeRO-1-style sharded outer sync: auto | 0 | 1 (0 = legacy replicated path)")
 _k("TORCHFT_OUTER_CHUNK_MB", "float", "16",
    "Pipelined outer-sync chunk size (MiB, capped at 64 chunks)")
+# --- streamed outer sync (zero-overhead DiLoCo fragments) -------------------
+_k("TORCHFT_STREAM_SYNC", "str", "auto",
+   "Stream DiLoCo fragment outer syncs under inner compute: auto / 0 / 1 (0 = legacy blocking sync, byte-identical; auto engages only when TORCHFT_STREAM_MAX_STALENESS >= 1 and the cadence has room; 1 forces with a derived staleness bar)")
+_k("TORCHFT_STREAM_MAX_STALENESS", "int", "0 (off)",
+   "Bounded-staleness bar in inner steps: a streamed fragment delta applies exactly this many steps after its sync point (clamped to per-fragment sync_every - delay - 1; identical on every replica)")
 # --- degraded mode (in-replica device loss, wire v5) ------------------------
 _k("TORCHFT_DEGRADED_MIN_FRAC", "float", "0 (never)",
    "Capacity floor: evict a replica wounded below this fraction (never below min_replicas/majority)")
@@ -286,6 +291,8 @@ _k("TPUFT_BENCH_SKIP_COORD", "bool", "0",
    "Skip the coordination-plane scale phase", "bench")
 _k("TPUFT_BENCH_SKIP_DEGRADED", "bool", "0",
    "Skip the degraded-mode (device-loss) bench phase", "bench")
+_k("TPUFT_BENCH_SKIP_STREAM", "bool", "0",
+   "Skip the streamed-outer-sync DiLoCo bench leg (diloco_faultfree_streaming)", "bench")
 _k("TPUFT_BENCH_SKIP_OBS", "bool", "0",
    "Skip the observability-overhead bench phase", "bench")
 _k("TPUFT_BENCH_OBS_STEPS", "int", "40",

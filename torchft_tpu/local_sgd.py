@@ -45,9 +45,10 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import jax
 import numpy as np
 
-from torchft_tpu import wire
+from torchft_tpu import knobs, wire
 from torchft_tpu.ddp import allreduce_pytree
 from torchft_tpu.manager import Manager
+from torchft_tpu.obs.spans import span as obs_span
 
 logger = logging.getLogger(__name__)
 
@@ -72,15 +73,73 @@ _RESHARD_LEN_TAG = wire.RESHARD_LEN_TAG
 _RESHARD_BLOB_TAG = wire.RESHARD_BLOB_TAG
 
 
-def _outer_shard_mode() -> str:
-    raw = os.environ.get(OUTER_SHARD_ENV, "auto").strip().lower()
+def _tri_state_mode(env_name: str) -> str:
+    """Parse an auto/0/1 mode knob (live-read: the drills flip these
+    mid-process)."""
+    raw = knobs.get_str(env_name, "auto").strip().lower()
     if raw in ("", "auto"):
         return "auto"
     if raw in ("1", "true", "on"):
         return "1"
     if raw in ("0", "false", "off"):
         return "0"
-    raise ValueError(f"unparseable {OUTER_SHARD_ENV}={raw!r} (auto|0|1)")
+    raise ValueError(f"unparseable {env_name}={raw!r} (auto|0|1)")
+
+
+def _outer_shard_mode() -> str:
+    return _tri_state_mode(OUTER_SHARD_ENV)
+
+
+# Streamed outer sync (zero-overhead DiLoCo fragments):
+#   auto — stream when the operator set a staleness budget
+#          (TORCHFT_STREAM_MAX_STALENESS >= 1) and the sync cadence has
+#          room for it; otherwise the legacy blocking schedule.  The
+#          staleness bar is an algorithmic hyperparameter (it decides how
+#          many inner steps run against pre-sync params before the delta
+#          lands), so auto never picks one silently.
+#   1    — force streaming with a derived default bar when none is set;
+#          falls back (loudly) to blocking when the cadence has no room
+#          (per-fragment sync_every - delay - 1 < 1).
+#   0    — the legacy blocking path, byte-for-byte (golden-fixture pinned).
+STREAM_SYNC_ENV = "TORCHFT_STREAM_SYNC"
+STREAM_MAX_STALENESS_ENV = "TORCHFT_STREAM_MAX_STALENESS"
+DEFAULT_STREAM_STALENESS = 4
+
+
+def _stream_mode() -> str:
+    return _tri_state_mode(STREAM_SYNC_ENV)
+
+
+def stream_stall_for(per_frag_sync: int, delay: int) -> int:
+    """The effective bounded-staleness bar, in inner steps, for one
+    fragment's streamed sync — 0 means streaming is off (blocking path).
+
+    The bar is clamped to the schedule's room: the barrier must fire
+    strictly before the NEXT fragment's prepare point (``per_frag_sync -
+    delay`` steps into the next round) so at most one streamed sync is
+    ever in flight and the round's quorum/vote protocol stays sequential.
+    A pure function of env + the (uniform, ctor-validated) cadence, so
+    every replica derives the identical schedule — the apply point being
+    deterministic is what keeps replicas bit-identical."""
+    mode = _stream_mode()
+    if mode == "0":
+        return 0
+    room = per_frag_sync - delay - 1
+    bar = knobs.get_int(STREAM_MAX_STALENESS_ENV, 0)
+    if mode == "auto":
+        return min(bar, room) if bar >= 1 and room >= 1 else 0
+    # mode == "1": forced — derive a bar when none is set
+    if room < 1:
+        logger.warning(
+            "%s=1 but the sync cadence has no staleness room "
+            "(per-fragment sync_every=%d, delay=%d): falling back to the "
+            "blocking outer sync",
+            STREAM_SYNC_ENV,
+            per_frag_sync,
+            delay,
+        )
+        return 0
+    return min(bar if bar >= 1 else DEFAULT_STREAM_STALENESS, room)
 
 
 class _OuterShard:
@@ -338,21 +397,63 @@ class LocalSGD:
         self._holder = holder
         self._sync_every = sync_every
         self._local_step = 0
+        # streamed sync (TORCHFT_STREAM_SYNC): LocalSGD is one whole-model
+        # "fragment" — the parameter average streams under the next inner
+        # steps and applies at the bounded-staleness barrier (inner
+        # progress during the stall is overwritten by the committed
+        # average, the same semantic as DiLoCo's alpha=0 apply)
+        self._stream_stall = stream_stall_for(sync_every, 0)
+        self._stream_work = None
 
     def __enter__(self) -> "LocalSGD":
         return self
 
     def __exit__(self, *exc: object) -> bool:
+        # drain a streamed sync submitted within the final stall window:
+        # abandoning it would end the run one committed average short of
+        # the blocking schedule at the same step count and leave an open
+        # quorum + a dangling stream-fence entry on the Manager
+        if self._stream_work is not None:
+            self._apply_streamed()
         return False
 
     def step(self) -> Optional[bool]:
         """Call after every inner optimizer step; returns the commit decision
-        on sync steps, None otherwise."""
+        on sync steps (at the staleness barrier when streaming), None
+        otherwise."""
         self._local_step += 1
+        committed: Optional[bool] = None
+        if (
+            self._stream_work is not None
+            and self._local_step >= self._stream_stall
+        ):
+            committed = self._apply_streamed()
         if self._local_step < self._sync_every:
-            return None
+            return committed
         self._local_step = 0
+        if self._stream_stall > 0:
+            self._manager.start_quorum()
+            with obs_span("stream::submit", frag=0):
+                # stream=0 registers the composite work in the Manager's
+                # stream-fence registry (FRAG_SUBMIT rides it)
+                self._stream_work = allreduce_pytree(
+                    self._manager, self._holder["params"], stream=0
+                )
+            return committed
         return self.sync()
+
+    def _apply_streamed(self) -> bool:
+        """Bounded-staleness barrier of a streamed parameter average: wait
+        the (by now usually drained) collective, vote, and adopt the
+        committed average."""
+        work, self._stream_work = self._stream_work, None
+        with obs_span("stream::barrier", frag=0):
+            averaged = work.wait()
+        committed = self._manager.should_commit()
+        self._manager.stream_resolved(0, committed)
+        if committed:
+            self._holder["params"] = averaged
+        return committed
 
     def sync(self) -> bool:
         """Average parameters across replicas and commit
@@ -398,6 +499,10 @@ class _Fragment:
         self._alpha = fragment_update_alpha
         self._work = None
         self._sharded_inflight = False
+        # True while a TORCHFT_STREAM_SYNC submit is in flight: the work
+        # lives in the Manager's stream-fence registry and perform_sync
+        # reports the FRAG_COMMIT/FRAG_ABORT outcome when it resolves
+        self._stream_inflight = False
 
         # cache the pytree layout once: the treedef (reused for every
         # unflatten), and this fragment's per-leaf (shape, dtype, flat
@@ -461,22 +566,36 @@ class _Fragment:
         assert self._backup_scratch is not None
         return self._psg_scratch[:padded], self._backup_scratch[:padded]
 
-    def prepare_sync(self) -> None:
+    def prepare_sync(self, stream: bool = False) -> None:
         """pseudogradient = backup − local, then async average
-        (``local_sgd.py:401-420``)."""
+        (``local_sgd.py:401-420``).  With ``stream=True`` the submit rides
+        the Manager's stream-fence registry (and, on the sharded path, the
+        fragment's rotating STREAM_OUTER tag window): inner compute
+        continues against pre-sync params and the caller applies the delta
+        at its bounded-staleness barrier via :meth:`perform_sync`."""
         local = self._current_local()
         assert self._work is None, "fragment already has an allreduce in flight"
-        if self._sharded():
-            self._prepare_sync_sharded(local)
-            return
-        pseudograds = [b - l for b, l in zip(self.backup, local)]
-        # in_place: pseudograds are freshly computed for this call and only
-        # the returned average is read afterwards
-        self._work = self._manager.allreduce(
-            pseudograds, should_quantize=self._should_quantize, in_place=True
-        )
+        self._stream_inflight = stream
+        with obs_span(
+            "stream::submit" if stream else "diloco::prepare",
+            frag=self._index,
+        ):
+            if self._sharded():
+                self._prepare_sync_sharded(local, stream)
+                return
+            pseudograds = [b - l for b, l in zip(self.backup, local)]
+            # in_place: pseudograds are freshly computed for this call and
+            # only the returned average is read afterwards
+            self._work = self._manager.allreduce(
+                pseudograds,
+                should_quantize=self._should_quantize,
+                in_place=True,
+                stream=self._index if stream else None,
+            )
 
-    def _prepare_sync_sharded(self, local: List[np.ndarray]) -> None:
+    def _prepare_sync_sharded(
+        self, local: List[np.ndarray], stream: bool = False
+    ) -> None:
         """Sharded outer sync: assemble the flat pseudo-gradient, (re)build
         this owner's shard for the current quorum, and hand the per-chunk
         outer update to the pipelined reduce_scatter→update→allgather."""
@@ -507,20 +626,35 @@ class _Fragment:
         )
         self._sharded_inflight = True
         self._work = self._manager.outer_shard_allreduce(
-            psg[: self._n], update_cb, should_quantize=self._should_quantize
+            psg[: self._n],
+            update_cb,
+            should_quantize=self._should_quantize,
+            stream=self._index if stream else None,
         )
 
     def perform_sync(self) -> bool:
         """Wait for the result, vote, and apply the outer step
-        (``local_sgd.py:422-475``)."""
+        (``local_sgd.py:422-475``).  On a streamed sync this is the
+        bounded-staleness barrier: the wait is ~free when the collectives
+        drained under the stalled inner steps, and the vote runs only
+        after the work resolved (the Manager's stream fence would
+        otherwise force it False)."""
         assert self._work is not None, "prepare_sync must run first"
-        result = self._work.wait()
+        streamed = self._stream_inflight
+        with obs_span(
+            "stream::barrier" if streamed else "diloco::perform",
+            frag=self._index,
+        ):
+            result = self._work.wait()
         self._work = None
         sharded = self._sharded_inflight
         self._sharded_inflight = False
+        self._stream_inflight = False
 
         local = self._current_local()
         committed = self._manager.should_commit()
+        if streamed:
+            self._manager.stream_resolved(self._index, committed)
 
         leaves = jax.tree_util.tree_leaves(self._holder["params"])
         if committed and sharded and result is not None:
@@ -634,6 +768,17 @@ class DiLoCo:
         self._holder = holder
         self._local_step = 0
         self._fragment_sync_delay = fragment_sync_delay
+        # streamed outer sync: the effective bounded-staleness bar (inner
+        # steps between a fragment's sync point and its delta applying; 0 =
+        # legacy blocking schedule).  Resolved ONCE at construction — the
+        # schedule must be identical on every replica and stable for the
+        # run, like the cadence itself.
+        self._stream_stall = stream_stall_for(
+            self._sync_every, fragment_sync_delay
+        )
+        # the fragment whose streamed sync is awaiting its barrier (at most
+        # one: the bar is clamped below the next prepare point)
+        self._stream_pending_frag: Optional[int] = None
 
         outer_txs = (
             outer_tx if isinstance(outer_tx, list) else [outer_tx] * n
@@ -657,6 +802,16 @@ class DiLoCo:
         return self
 
     def __exit__(self, *exc: object) -> bool:
+        # drain a streamed sync whose sync step already passed but whose
+        # staleness barrier hasn't fired: abandoning it would end the run
+        # one committed round short of the blocking schedule and leave a
+        # dangling stream-fence entry.  (A fragment merely PREPARED —
+        # sync step not yet reached — is abandoned exactly like the
+        # blocking schedule abandons it.)
+        if self._stream_pending_frag is not None:
+            frag = self._stream_pending_frag
+            self._stream_pending_frag = None
+            self._fragments[frag].perform_sync()
         return False
 
     def _current_fragment(self) -> int:
@@ -688,12 +843,45 @@ class DiLoCo:
 
         return _guard()
 
+    def streaming(self) -> bool:
+        """True when the streamed scheduler is engaged (TORCHFT_STREAM_SYNC
+        resolved against this cadence at construction)."""
+        return self._stream_stall > 0
+
     def step(self) -> Optional[bool]:
         """Call after every inner optimizer step (the reference's optimizer
         post-hook, ``local_sgd.py:745-795``); returns the commit decision on
-        sync steps, None otherwise."""
+        sync steps, None otherwise.
+
+        Streamed schedule (``TORCHFT_STREAM_SYNC``): the sync step no
+        longer blocks — the fragment's reduce_scatter → sharded update →
+        allgather keeps draining on its background path while inner
+        compute continues against pre-sync params, and the identical
+        wire-format delta applies ``stall`` inner steps later at the
+        bounded-staleness barrier (where the commit decision is returned).
+        The barrier position is a pure function of the cadence, so every
+        replica applies at the same inner step — replicas stay
+        bit-identical, exactly as on the blocking path."""
         self._manager.allow_state_dict_read()
         self._local_step += 1
+
+        committed: Optional[bool] = None
+        if (
+            self._stream_pending_frag is not None
+            and self._local_step >= self._stream_stall
+        ):
+            # bounded-staleness barrier: resolve the streamed fragment
+            # BEFORE this round's prepare can open a new quorum (the bar
+            # is clamped strictly below the prepare point)
+            frag = self._stream_pending_frag
+            self._stream_pending_frag = None
+            logger.info(
+                "Stream barrier fragment=%d step=%d manager_step=%d",
+                frag,
+                self._local_step,
+                self._manager.current_step(),
+            )
+            committed = self._fragments[frag].perform_sync()
 
         if self._local_step == self._sync_every - self._fragment_sync_delay:
             # quorum + overlap the pseudogradient allreduce with the next τ
@@ -703,17 +891,23 @@ class DiLoCo:
             logger.info(
                 "Preparing fragment=%d step=%d", fragment, self._local_step
             )
-            self._fragments[fragment].prepare_sync()
+            self._fragments[fragment].prepare_sync(stream=self.streaming())
             if self._fragment_sync_delay > 0:
-                return None
+                return committed
 
         if self._local_step < self._sync_every:
-            return None
+            return committed
 
         assert self._local_step == self._sync_every, (
             f"local_step={self._local_step} overran sync_every={self._sync_every}"
         )
         fragment = self._current_fragment()
+        if self.streaming():
+            # the sync step streams: hand the fragment to the stall window
+            # and keep training — perform_sync runs at the barrier above
+            self._stream_pending_frag = fragment
+            self._local_step = 0
+            return committed
         logger.info(
             "Syncing fragment=%d step=%d manager_step=%d",
             fragment,

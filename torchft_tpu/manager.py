@@ -32,7 +32,7 @@ import threading
 import time
 import uuid
 from enum import Enum
-from typing import Callable, Dict, List, Optional, TypeVar, Union, cast
+from typing import Callable, Dict, List, Optional, Tuple, TypeVar, Union, cast
 
 import numpy as np
 
@@ -210,6 +210,16 @@ class Manager:
         # reference's accelerator-stream synchronize, ``manager.py:888-893``)
         self._pending_works: List[Work] = []
         self._pending_works_lock = threading.Lock()
+        # streamed fragment syncs (TORCHFT_STREAM_SYNC): per-fragment Works
+        # submitted out-of-band of _pending_works so a round's vote never
+        # silently fences them — should_commit instead REFUSES (votes
+        # False) while any streamed sync is unresolved, the PR-11
+        # begin_relower fence pattern, so a half-streamed sync can never
+        # commit.  The scheduler resolves (waits) a fragment's work before
+        # its barrier vote, making the fence a no-op on the healthy path.
+        # frag -> (work, submit-time step): the step keys the
+        # FRAG_SUBMIT/FRAG_COMMIT pair on the flight timeline
+        self._stream_pending: Dict[int, Tuple[Work, int]] = {}
 
         self._step = 0
         self._batches_committed = 0
@@ -766,9 +776,16 @@ class Manager:
         self._healing = False
         self._flight.set_context(step=self._step)
         self._flight.record(FlightEvent.QUORUM_START, step=self._step)
-        # drop stale works from a step the caller abandoned without voting
+        # drop stale works from a step the caller abandoned without voting;
+        # RESOLVED stream entries whose barrier never ran are abandoned the
+        # same way (their staged outer state was never adopted), but an
+        # entry still in flight stays — the vote fence must keep refusing
+        # until the collective actually drains
         with self._pending_works_lock:
             self._pending_works.clear()
+            self._stream_pending = {
+                f: e for f, e in self._stream_pending.items() if not e[0].done()
+            }
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -1241,6 +1258,8 @@ class Manager:
         data: Union[np.ndarray, List[np.ndarray]],
         should_quantize: bool = False,
         in_place: bool = False,
+        stream: Optional[int] = None,
+        register_pending: bool = True,
     ) -> Work:
         """Fault-tolerant AVG allreduce of gradients across the participating
         replicas (``manager.py:410-493``).
@@ -1255,9 +1274,28 @@ class Manager:
         buffers you built for this call and will not read afterwards (the
         ddp bucket path does); buffers that alias live state (LocalSGD's
         host params) must keep the default.
+
+        ``stream``, when given, marks this as an ASYNC streamed fragment
+        submit (the TORCHFT_STREAM_SYNC scheduler riding the legacy
+        replicated outer wire): the work registers in the stream-fence
+        registry instead of ``_pending_works`` — same contract as
+        :meth:`outer_shard_allreduce`'s ``stream``.
+
+        ``register_pending=False`` registers the work NOWHERE: for
+        constituent works whose owner fences a composite covering them
+        (``ddp.allreduce_pytree``'s streamed bucket rings — the composite
+        is what rides the stream-fence registry).
         """
+
+        def _failed_fast(w: Work) -> Work:
+            # a fail-fast streamed submit still registers (and stamps
+            # FRAG_SUBMIT): the caller's barrier will stream_resolved the
+            # fragment, and a FRAG_ABORT must always have a paired submit
+            # on the flight timeline
+            return w if stream is None else self.stream_submitted(stream, w)
+
         if self.errored():
-            return DummyWork(data)
+            return _failed_fast(DummyWork(data))
 
         # a failed quorum funnels like any collective error: the input rides
         # through unchanged and the vote discards the step — errors must
@@ -1266,7 +1304,7 @@ class Manager:
             self.wait_quorum()
         except Exception as e:  # noqa: BLE001
             self.report_error(e)
-            return DummyWork(data)
+            return _failed_fast(DummyWork(data))
         num_participants = self.num_participants()
 
         if not self.is_participating():
@@ -1306,12 +1344,15 @@ class Manager:
                 return [_div(a, num_participants) for a in cast(list, value)]
 
             wrapped = self.wrap_work(work.then(_normalize), data)
-            self._register_pending(wrapped)
+            if stream is not None:
+                self.stream_submitted(stream, wrapped)
+            elif register_pending:
+                self._register_pending(wrapped)
             return wrapped
         except Exception as e:  # noqa: BLE001
             self._logger.exception(f"got exception in all reduce -- skipping remaining: {e}")
             self.report_error(e)
-            return DummyWork(data)
+            return _failed_fast(DummyWork(data))
 
     def allreduce_prequantized(
         self, q: np.ndarray, scales: np.ndarray, n: int
@@ -1393,6 +1434,7 @@ class Manager:
         flat: np.ndarray,
         update_cb: Callable[[int, int, np.ndarray], np.ndarray],
         should_quantize: bool = False,
+        stream: Optional[int] = None,
     ) -> Work:
         """Fault-tolerant sharded outer sync (ZeRO-1 over the replica dim):
         chunk-pipelined ``reduce_scatter → update_cb → allgather`` of the
@@ -1405,14 +1447,31 @@ class Manager:
         Work.  The value is the f32 delta (``params = backup + delta``) —
         or ``None`` after any error, which the caller must treat as a
         discarded step (the vote will be False).  Pipeline phase timings
-        land in ``last_quorum_timings`` as ``outer_shard_*``."""
+        land in ``last_quorum_timings`` as ``outer_shard_*``.
+
+        ``stream``, when given, is the fragment index of an ASYNC streamed
+        submit (the TORCHFT_STREAM_SYNC scheduler in ``local_sgd.py``): the
+        collectives frame in that fragment's rotating STREAM_OUTER tag
+        window, the work registers in the stream-fence registry instead of
+        ``_pending_works`` (so ``start_quorum``'s stale-work drop and the
+        vote's fence never touch it), and a FRAG_SUBMIT flight event marks
+        the submit.  :meth:`should_commit` votes False while any streamed
+        work is unresolved — a half-streamed sync NEVER commits; the caller
+        must ``wait()`` the work at its bounded-staleness barrier before
+        voting."""
+
+        def _failed_fast(w: Work) -> Work:
+            # fail-fast streamed submits still register + stamp FRAG_SUBMIT
+            # so the barrier's FRAG_ABORT always has its pair (see allreduce)
+            return w if stream is None else self.stream_submitted(stream, w)
+
         if self.errored():
-            return DummyWork(None)
+            return _failed_fast(DummyWork(None))
         try:
             self.wait_quorum()
         except Exception as e:  # noqa: BLE001 — funnel, never raise
             self.report_error(e)
-            return DummyWork(None)
+            return _failed_fast(DummyWork(None))
         num_participants = self.num_participants()
         if not self.is_participating():
             flat = np.zeros_like(flat)
@@ -1427,12 +1486,29 @@ class Manager:
         if self._capacity_weights_engaged():
             weight = self._own_capacity_weight() if self.is_participating() else 0.0
 
+        from torchft_tpu import wire as wire_mod
         from torchft_tpu.collectives import outer_sharded_sync
         from torchft_tpu.quantization import quant_kind
 
         kind = quant_kind() if should_quantize else None
         timings = self.last_quorum_timings
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        if stream is None:
+            tag_base, tag_span = (
+                wire_mod.OUTER_SHARD_TAG_BASE,
+                wire_mod.OUTER_SHARD_TAG_SPAN,
+            )
+        else:
+            # window keyed on (outer step + fragment): consecutive streamed
+            # syncs land in distinct windows even at num_fragments=1 (the
+            # step advances every committed round, and a failed round
+            # poisons the comm epoch, whose reconfigure flushes the old
+            # connections), and the key is quorum-shared state, so a healed
+            # replica picks the same window as the survivors — a local
+            # submit counter would drift permanently after a restart
+            tag_base, tag_span = wire_mod.stream_frag_tag_window(
+                self._step + stream
+            )
 
         def _run() -> None:
             tm: Dict[str, float] = {}
@@ -1453,6 +1529,8 @@ class Manager:
                         if self._spare_replica_ids
                         else None
                     ),
+                    tag_base=tag_base,
+                    tag_span=tag_span,
                 )
                 fut.set_result(delta)
             except Exception as e:  # noqa: BLE001 — funnel, never raise
@@ -1468,8 +1546,48 @@ class Manager:
             target=_run, name="tpuft_outer_shard_sync", daemon=True
         ).start()
         out = Work(fut)
-        self._register_pending(out)
+        if stream is None:
+            self._register_pending(out)
+        else:
+            self.stream_submitted(stream, out)
         return out
+
+    def stream_submitted(self, frag: int, work: Work) -> Work:
+        """Register an async streamed fragment sync in the stream-fence
+        registry (NOT ``_pending_works`` — see :meth:`outer_shard_allreduce`)
+        and stamp the FRAG_SUBMIT flight event.  Returns ``work``."""
+        self._flight.record(
+            FlightEvent.FRAG_SUBMIT, step=self._step, frag=frag
+        )
+        with self._pending_works_lock:
+            self._stream_pending[frag] = (work, self._step)
+        return work
+
+    def stream_unresolved(self) -> List[int]:
+        """Fragment indices of streamed outer syncs whose collectives are
+        still in flight.  Non-empty at vote time forces the vote False
+        (:meth:`should_commit`) — the commit fence that guarantees a
+        half-streamed sync never commits."""
+        with self._pending_works_lock:
+            return sorted(
+                f
+                for f, (w, _s) in self._stream_pending.items()
+                if not w.done()
+            )
+
+    def stream_resolved(self, frag: int, committed: Optional[bool]) -> None:
+        """Mark a streamed fragment sync fully resolved (waited + voted +
+        applied or discarded) and record its lifecycle flight event —
+        stamped with the SUBMIT-time step, so the FRAG_SUBMIT/FRAG_COMMIT
+        pair shares a ``(step, frag)`` key on the merged timeline (a
+        committed vote bumps ``_step`` before the caller gets here)."""
+        with self._pending_works_lock:
+            entry = self._stream_pending.pop(frag, None)
+        self._flight.record(
+            FlightEvent.FRAG_COMMIT if committed else FlightEvent.FRAG_ABORT,
+            step=entry[1] if entry is not None else self._step,
+            frag=frag,
+        )
 
     def _register_pending(self, work: Work) -> None:
         with self._pending_works_lock:
@@ -1540,6 +1658,21 @@ class Manager:
                 RuntimeError(
                     "degraded re-lower in progress; refusing to commit a "
                     "half-relowered step"
+                )
+            )
+
+        if stale_frags := self.stream_unresolved():
+            # stream fence (the begin_relower pattern): a streamed fragment
+            # sync whose collectives are still in flight at a vote means
+            # the protocol was violated (the scheduler waits the work at
+            # its staleness barrier before voting) — committing would let
+            # this replica adopt a half-streamed delta later while peers
+            # may have discarded it.  Force the vote False.
+            self.report_error(
+                RuntimeError(
+                    f"streamed fragment sync(s) {stale_frags} still in "
+                    "flight at the commit vote; refusing to commit a "
+                    "half-streamed sync"
                 )
             )
 

@@ -99,6 +99,10 @@ class FlightEvent(enum.IntEnum):
     LANE_FAILOVER = 24  # one lane failed over to a survivor
     # -- lighthouse policy (python only) -------------------------------------
     EVICT_SLOW = 25  # straggler shed from the quorum
+    # -- streamed fragment sync (python only) --------------------------------
+    FRAG_SUBMIT = 26  # streamed fragment outer sync submitted (detail: frag)
+    FRAG_COMMIT = 27  # streamed fragment delta applied on a committed vote
+    FRAG_ABORT = 28  # streamed fragment sync discarded (failed vote / error)
 
 
 # data-plane events the native tier may record; the ftlint checker requires

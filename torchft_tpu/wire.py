@@ -197,6 +197,21 @@ class ErrCode(IntEnum):
 # neither can collide with user allocations.
 
 # -- USER tag allocations (value space: what callers pass as `tag=`) --------
+STREAM_OUTER_TAG_BASE = 8  # streamed DiLoCo fragment sync (collectives.py):
+STREAM_OUTER_TAG_SPAN = 88  # 8..95, carved into STREAM_FRAG_WINDOWS rotating
+#   per-fragment windows so consecutive streamed fragment syncs can never
+#   alias tags even if a late frame lingers past its sync's resolution.
+#   Kept below every legacy allocation (and far below the wire offsets) so
+#   the namespace-composition properties match the proven blocking path —
+#   but ABOVE ftlint's ad-hoc literal ceiling (tags <= 7 are lint-legal
+#   without registration; carving the window into that range would let an
+#   unflagged literal alias streamed frames).
+STREAM_FRAG_WINDOWS = 4  # a streamed sync frames in window key % WINDOWS
+#   (key = outer step + fragment index — see Manager.outer_shard_allreduce)
+STREAM_FRAG_WINDOW_SPAN = STREAM_OUTER_TAG_SPAN // STREAM_FRAG_WINDOWS  # 22
+#   tags per window = 11 pipeline chunks (2 tags/chunk); the chunk planner
+#   grows the chunk size past TORCHFT_OUTER_CHUNK_MB when a fragment would
+#   need more chunks than its window holds.
 QUANT_RING_TAG = 103  # quantized ring allreduce (collectives.py)
 QUANT_PIPELINE_TAG_BASE = 110  # windowed quant pipeline, 2 tags/window
 QUANT_PIPELINE_TAG_SPAN = 770  # 110..879 (384 windows ≈ 1.5 GB @ 4 MB)
@@ -240,6 +255,7 @@ HEAL_STEP_TAG_STRIDE = 10_000_000  # step*stride salting, p2p lane only
 # WARN at runtime when a payload would exceed the declared span (see
 # collectives._allreduce_pipelined_sync).
 USER_TAG_ALLOCATIONS = {
+    "STREAM_OUTER": (STREAM_OUTER_TAG_BASE, STREAM_OUTER_TAG_SPAN),
     "QUANT_RING": (QUANT_RING_TAG, 1),
     "QUANT_PIPELINE": (QUANT_PIPELINE_TAG_BASE, QUANT_PIPELINE_TAG_SPAN),
     "RESHARD_LEN": (RESHARD_LEN_TAG, 1),
@@ -264,6 +280,23 @@ INTERNAL_TAG_BASES = {
     "HEAL": HEAL_TAG_BASE,
     "HEAL_STEP_STRIDE": HEAL_STEP_TAG_STRIDE,
 }
+
+
+def stream_frag_tag_window(key: int) -> "tuple[int, int]":
+    """``(tag_base, tag_span)`` of the rotating STREAM_OUTER window a
+    streamed fragment sync must frame its chunk collectives in.  A pure
+    function of the caller's window key, so every replica picks the
+    identical window with no wire metadata.  The scheduler keys on
+    ``outer step + fragment index`` (quorum-shared state, so a healed
+    replica agrees with the survivors): consecutive streamed syncs land
+    in disjoint windows — including at ``num_fragments=1``, where the
+    advancing step alone rotates them — so a streamed sync can never
+    pair a lingering frame from the previous (already-resolved) sync."""
+    window = key % STREAM_FRAG_WINDOWS
+    return (
+        STREAM_OUTER_TAG_BASE + window * STREAM_FRAG_WINDOW_SPAN,
+        STREAM_FRAG_WINDOW_SPAN,
+    )
 
 
 class WireError(RuntimeError):
